@@ -1,0 +1,100 @@
+"""Benchmark: GPT-2 training throughput on the real chip(s).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The reference publishes no numbers (BASELINE.md), so `vs_baseline` is measured
+against this repo's own previous round (BENCH_r*.json if present, else 1.0).
+Headline metric: GPT-2 124M tokens/sec/chip on the reference demo workload
+shape (T=1024, AdamW — reference example/ddp/train.py:23-35), batch size
+scaled to fill the chip.
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+
+def measure(engine, state, batch, warmup=3, iters=10):
+    # NB: float(loss) (device->host transfer) is the sync barrier; on the
+    # axon tunnel platform block_until_ready returns early.
+    for _ in range(warmup):
+        state, loss = engine.step(state, batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = engine.step(state, batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return dt / iters, state
+
+
+def main():
+    from tiny_deepspeed_tpu import AdamW, GPT2Model, SingleDevice, make_mesh
+    from tiny_deepspeed_tpu.models import GPT2_PRESETS
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-124m")
+    b = int(os.environ.get("BENCH_BATCH", "8"))
+    t = int(os.environ.get("BENCH_SEQ", "1024"))
+
+    model = GPT2Model(GPT2_PRESETS[model_name])
+    n_chips = len(jax.devices())
+    mesh = make_mesh()
+    if n_chips == 1:
+        engine = SingleDevice(model, AdamW(lr=1e-5, weight_decay=0.1),
+                              mesh=mesh)
+    else:
+        from tiny_deepspeed_tpu import Zero2
+        engine = Zero2(model, AdamW(lr=1e-5, weight_decay=0.1), mesh=mesh)
+        b *= n_chips
+
+    state = engine.init(jax.random.PRNGKey(0))
+    idx = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0,
+                             model.config.vocab_size, jnp.int32)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (b, t), 0,
+                             model.config.vocab_size, jnp.int32)
+
+    step_time, state = measure(engine, state, (idx, tgt))
+    tokens_per_sec_chip = b * t / step_time / n_chips
+
+    # model FLOPs estimate (6 * params * tokens per fwd+bwd) for MFU context
+    n_params = model.num_params()
+    flops_per_step = 6 * n_params * b * t
+    # v5e bf16 peak ~197 TFLOP/s/chip
+    mfu = flops_per_step / step_time / n_chips / 197e12
+
+    prev = 1.0
+    prior = sorted(glob.glob(os.path.join(os.path.dirname(__file__),
+                                          "BENCH_r*.json")))
+    if prior:
+        try:
+            with open(prior[-1]) as f:
+                prev_val = json.load(f).get("value")
+            if prev_val:
+                prev = tokens_per_sec_chip / prev_val
+        except Exception:
+            pass
+
+    print(json.dumps({
+        "metric": f"{model_name}_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(prev, 3),
+        "extra": {
+            "chips": n_chips,
+            "batch": b,
+            "seq_len": t,
+            "step_time_s": round(step_time, 4),
+            "approx_mfu": round(mfu, 3),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

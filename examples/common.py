@@ -1,0 +1,92 @@
+"""Shared harness for the five train entry points.
+
+Parity with the reference example scripts (example/{single_device,ddp,zero1,
+zero2,zero3}/train.py): seed, random token batches of (B, T=1024), model +
+engine construction, a 100-iteration loop printing per-iter loss from process
+0.  Differences, deliberate:
+
+  * one global batch sharded over the mesh replaces per-rank private batches
+    (the reference seeds *differently per rank* — quirk #14 — so its global
+    batch is implicit; here it is explicit);
+  * `jax.distributed.initialize`/mesh replaces torchrun env:// rendezvous;
+  * hyperparameters mirror the reference: AdamW lr=1e-5, wd=0.1, 100 iters
+    (reference ddp/train.py:27-29).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tiny_deepspeed_tpu import (
+    AdamW,
+    GPT2Model,
+    init_distributed,
+    make_mesh,
+)
+from tiny_deepspeed_tpu.models import GPT2_PRESETS
+
+
+def parse_args(default_model="gpt2-124m"):
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--cpu-devices", type=int, default=0, metavar="N",
+        help="debug: run on N virtual CPU devices instead of the TPU "
+             "(JAX host-platform trick; lets every ZeRO mode run without "
+             "a pod — the reference has no such story, SURVEY §4)",
+    )
+    p.add_argument("--model", default=default_model,
+                   choices=sorted(GPT2_PRESETS))
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--batch-per-device", type=int, default=1)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--lr", type=float, default=1e-5)
+    p.add_argument("--weight-decay", type=float, default=0.1)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args()
+
+
+def run(engine_cls, args, single_device=False):
+    if getattr(args, "cpu_devices", 0):
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    init_distributed()
+    model = GPT2Model(GPT2_PRESETS[args.model])
+
+    if single_device:
+        mesh = make_mesh(devices=[jax.devices()[0]])
+        n_dev = 1
+    else:
+        mesh = make_mesh()
+        n_dev = mesh.devices.size
+
+    engine = engine_cls(
+        model, AdamW(lr=args.lr, weight_decay=args.weight_decay), mesh=mesh
+    )
+    if jax.process_index() == 0:
+        print(engine.describe())
+        print(f"model={args.model} params={model.num_params()/1e6:.1f}M "
+              f"global_batch={args.batch_per_device * n_dev} T={args.seq_len}")
+
+    state = engine.init(jax.random.PRNGKey(args.seed))
+    b = args.batch_per_device * n_dev
+    vocab = model.config.vocab_size
+
+    data_key = jax.random.PRNGKey(args.seed + 1)
+    t0 = time.perf_counter()
+    for it in range(args.iters):
+        data_key, k1, k2 = jax.random.split(data_key, 3)
+        idx = jax.random.randint(k1, (b, args.seq_len), 0, vocab, jnp.int32)
+        tgt = jax.random.randint(k2, (b, args.seq_len), 0, vocab, jnp.int32)
+        state, loss = engine.step(state, (idx, tgt))
+        if jax.process_index() == 0:
+            print(f"iter {it:3d} loss {float(loss):.4f}")
+    dt = time.perf_counter() - t0
+    if jax.process_index() == 0:
+        toks = args.iters * b * args.seq_len
+        print(f"done: {args.iters} iters in {dt:.1f}s "
+              f"({toks / dt:.0f} tokens/s)")
+    return state

@@ -1,0 +1,13 @@
+"""DDP: replicated params, sharded batch, all-reduced grads (parity: reference example/ddp/train.py:15-37)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import parse_args, run  # noqa: E402
+from tiny_deepspeed_tpu import DDP  # noqa: E402
+
+if __name__ == "__main__":
+    run(DDP, parse_args(default_model="gpt2-124m"))

@@ -1,0 +1,13 @@
+"""Single-device GPT-2 training (parity: reference example/single_device/train.py:14-28)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import parse_args, run  # noqa: E402
+from tiny_deepspeed_tpu import SingleDevice  # noqa: E402
+
+if __name__ == "__main__":
+    run(SingleDevice, parse_args(), single_device=True)

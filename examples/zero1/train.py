@@ -1,0 +1,13 @@
+"""ZeRO-1: sharded optimizer state (parity: reference example/zero1/train.py:16-46)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import parse_args, run  # noqa: E402
+from tiny_deepspeed_tpu import Zero1  # noqa: E402
+
+if __name__ == "__main__":
+    run(Zero1, parse_args(default_model="gpt2-350m"))

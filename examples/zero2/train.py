@@ -1,0 +1,13 @@
+"""ZeRO-2: sharded optimizer state + gradients (parity: reference example/zero2/train.py:16-46)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import parse_args, run  # noqa: E402
+from tiny_deepspeed_tpu import Zero2  # noqa: E402
+
+if __name__ == "__main__":
+    run(Zero2, parse_args(default_model="gpt2-1.5b"))

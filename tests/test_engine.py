@@ -1,0 +1,107 @@
+"""Engine tests on the 8-device CPU mesh: every ZeRO stage trains and all
+stages produce the SAME loss trajectory as single-device for the same global
+batch (the numerical-equivalence criterion SURVEY §4 calls for — and a
+stronger property than the reference, whose DDP sums grads, quirk #1)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tiny_deepspeed_tpu import (
+    GPTConfig, GPT2Model, AdamW, SGD,
+    SingleDevice, DDP, Zero1, Zero2, Zero3, make_mesh,
+)
+
+TINY = GPTConfig(
+    block_size=32, vocab_size=128, n_layer=2, n_head=2, n_embd=32,
+    compute_dtype=jnp.float32,
+)
+
+
+def make_batch(key, b=8, t=32, vocab=128):
+    k1, k2 = jax.random.split(key)
+    idx = jax.random.randint(k1, (b, t), 0, vocab)
+    tgt = jax.random.randint(k2, (b, t), 0, vocab)
+    return idx, tgt
+
+
+def run_steps(engine, n=3, seed=0):
+    model_key = jax.random.PRNGKey(seed)
+    state = engine.init(model_key)
+    losses = []
+    for i in range(n):
+        batch = make_batch(jax.random.PRNGKey(100 + i))
+        state, loss = engine.step(state, batch)
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPT2Model(TINY)
+
+
+class TestEngines:
+    def test_mesh_has_8_devices(self):
+        assert len(jax.devices()) == 8
+
+    def test_single_device_trains(self, model):
+        losses = run_steps(SingleDevice(model, AdamW(lr=1e-3)))
+        assert losses[-1] < losses[0]
+
+    @pytest.mark.parametrize("Engine", [DDP, Zero1, Zero2, Zero3])
+    def test_stage_trains_and_matches_single_device(self, model, Engine):
+        ref = run_steps(SingleDevice(model, AdamW(lr=1e-3)))
+        got = run_steps(Engine(model, AdamW(lr=1e-3)))
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_zero3_params_actually_sharded(self, model):
+        eng = Zero3(model, AdamW(lr=1e-3))
+        state = eng.init(jax.random.PRNGKey(0))
+        w = state.params["h.mlp.fc.w"]  # (L, D, 4D)
+        sharding = w.sharding
+        assert sharding.spec != jax.sharding.PartitionSpec()
+        # a shard must be 1/8 of the tensor
+        shard = sharding.shard_shape(w.shape)
+        assert np.prod(shard) * 8 == np.prod(w.shape)
+
+    def test_zero1_opt_state_sharded_params_replicated(self, model):
+        eng = Zero1(model, AdamW(lr=1e-3))
+        state = eng.init(jax.random.PRNGKey(0))
+        p = state.params["h.mlp.fc.w"]
+        assert p.sharding.spec == jax.sharding.PartitionSpec()
+        m = state.opt_state["state"]["h.mlp.fc.w"]["m"]
+        shard = m.sharding.shard_shape(m.shape)
+        assert np.prod(shard) * 8 == np.prod(m.shape)
+
+    def test_sgd_engine(self, model):
+        losses = run_steps(DDP(model, SGD(lr=1e-2, momentum=0.9)))
+        assert losses[-1] < losses[0]
+
+    def test_grad_accumulation_matches_large_batch(self, model):
+        # (2, 4, T) microbatched == (8, T) in one shot
+        opt = lambda: SGD(lr=1e-2)
+        e1 = SingleDevice(model, opt())
+        e2 = SingleDevice(model, opt(), accum_steps=2)
+        s1 = e1.init(jax.random.PRNGKey(0))
+        s2 = e2.init(jax.random.PRNGKey(0))
+        idx, tgt = make_batch(jax.random.PRNGKey(42))
+        s1, l1 = e1.step(s1, (idx, tgt))
+        mb = (idx.reshape(2, 4, -1), tgt.reshape(2, 4, -1))
+        s2, l2 = e2.step(s2, mb)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+        for n in s1.params:
+            np.testing.assert_allclose(
+                s1.params[n], s2.params[n], rtol=1e-5, atol=1e-6
+            )
+
+    def test_rank_map_exposed(self, model):
+        eng = Zero2(model, AdamW(lr=1e-3))
+        assert set(eng.rank_map) == set(model.param_shapes())
+        assert max(eng.rank_map.values()) <= 7
+
+    def test_describe(self, model):
+        assert "stage=2" in Zero2(model, AdamW(lr=1e-3)).describe()

@@ -1,0 +1,103 @@
+"""Optimizer semantics vs torch CPU reference (torch is in the image).
+
+The reference's optimizers are torch-semantics (core/optim/sgd.py, adamw.py);
+checking against torch.optim pins our math to the same formulas the reference
+intends — except the two documented quirk fixes (global step counter,
+SURVEY §8 #2) which torch also uses.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from tiny_deepspeed_tpu.optim import SGD, AdamW
+
+
+def run_mine(opt, param, grads):
+    params = {"w": jnp.asarray(param)}
+    state = opt.init(params)
+    for g in grads:
+        params, state = opt.update(params, {"w": jnp.asarray(g)}, state)
+    return np.asarray(params["w"])
+
+
+def run_torch(make_opt, param, grads):
+    p = torch.nn.Parameter(torch.tensor(param))
+    opt = make_opt([p])
+    for g in grads:
+        opt.zero_grad()
+        p.grad = torch.tensor(g)
+        opt.step()
+    return p.detach().numpy()
+
+
+PARAM = np.linspace(-1, 1, 12).astype(np.float32).reshape(3, 4)
+GRADS = [np.cos(PARAM * (i + 1)).astype(np.float32) for i in range(5)]
+
+
+class TestSGD:
+    def test_vanilla(self):
+        mine = run_mine(SGD(lr=0.1), PARAM, GRADS)
+        ref = run_torch(lambda ps: torch.optim.SGD(ps, lr=0.1), PARAM, GRADS)
+        np.testing.assert_allclose(mine, ref, rtol=1e-5, atol=1e-6)
+
+    def test_momentum_weight_decay(self):
+        mine = run_mine(
+            SGD(lr=0.1, momentum=0.9, weight_decay=0.01), PARAM, GRADS
+        )
+        ref = run_torch(
+            lambda ps: torch.optim.SGD(ps, lr=0.1, momentum=0.9,
+                                       weight_decay=0.01),
+            PARAM, GRADS,
+        )
+        np.testing.assert_allclose(mine, ref, rtol=1e-5, atol=1e-6)
+
+    def test_nesterov(self):
+        mine = run_mine(SGD(lr=0.05, momentum=0.9, nesterov=True), PARAM, GRADS)
+        ref = run_torch(
+            lambda ps: torch.optim.SGD(ps, lr=0.05, momentum=0.9,
+                                       nesterov=True),
+            PARAM, GRADS,
+        )
+        np.testing.assert_allclose(mine, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestAdamW:
+    def test_l2_mode_matches_torch_adam(self):
+        # reference AdamW folds wd into grad (quirk #3) == torch.optim.Adam
+        mine = run_mine(AdamW(lr=1e-2, weight_decay=0.1), PARAM, GRADS)
+        ref = run_torch(
+            lambda ps: torch.optim.Adam(ps, lr=1e-2, weight_decay=0.1),
+            PARAM, GRADS,
+        )
+        np.testing.assert_allclose(mine, ref, rtol=1e-4, atol=1e-6)
+
+    def test_decoupled_mode_matches_torch_adamw(self):
+        mine = run_mine(
+            AdamW(lr=1e-2, weight_decay=0.1, decoupled=True), PARAM, GRADS
+        )
+        ref = run_torch(
+            lambda ps: torch.optim.AdamW(ps, lr=1e-2, weight_decay=0.1),
+            PARAM, GRADS,
+        )
+        # torch AdamW decouples as p -= lr*wd*p (multiplicative), ours adds
+        # wd*p to the update: p -= lr*(update + wd*p) — identical math.
+        np.testing.assert_allclose(mine, ref, rtol=1e-4, atol=1e-6)
+
+    def test_amsgrad(self):
+        mine = run_mine(AdamW(lr=1e-2, amsgrad=True, weight_decay=0.0),
+                        PARAM, GRADS)
+        ref = run_torch(
+            lambda ps: torch.optim.Adam(ps, lr=1e-2, amsgrad=True),
+            PARAM, GRADS,
+        )
+        np.testing.assert_allclose(mine, ref, rtol=1e-4, atol=1e-6)
+
+    def test_maximize(self):
+        mine = run_mine(AdamW(lr=1e-2, maximize=True, weight_decay=0.0),
+                        PARAM, GRADS)
+        ref = run_torch(
+            lambda ps: torch.optim.Adam(ps, lr=1e-2, maximize=True),
+            PARAM, GRADS,
+        )
+        np.testing.assert_allclose(mine, ref, rtol=1e-4, atol=1e-6)

@@ -1,0 +1,76 @@
+"""Property tests for the cache rank map (partition_tensors).
+
+The reference's only check is a printing __main__ self-test
+(reference partition.py:108-126); these are real properties: totality,
+contiguity, monotonicity, evenness at priority=1, empty-part warning.
+"""
+
+import warnings
+
+import pytest
+
+from tiny_deepspeed_tpu import partition_tensors
+from tiny_deepspeed_tpu.parallel.partition import partition_sizes
+
+
+def shapes(*specs):
+    return {f"p{i}": s for i, s in enumerate(specs)}
+
+
+class TestPartition:
+    def test_total_and_contiguous(self):
+        t = shapes((10, 10), (5,), (20, 20), (3, 3), (50,), (7, 7))
+        table = partition_tensors(t, 3)
+        assert set(table) == set(t)
+        ranks = [table[f"p{i}"] for i in range(6)]
+        # contiguous, monotonically nondecreasing, starts at 0
+        assert ranks[0] == 0
+        assert all(b - a in (0, 1) for a, b in zip(ranks, ranks[1:]))
+        assert max(ranks) <= 2
+
+    def test_single_part(self):
+        t = shapes((4, 4), (8,))
+        assert set(partition_tensors(t, 1).values()) == {0}
+
+    def test_evenness_priority_one_is_balanced(self):
+        # equal-size tensors, priority 1 -> perfect split
+        t = shapes(*[(100,)] * 8)
+        table = partition_tensors(t, 4, evenness_priority=1.0)
+        sizes = partition_sizes(table, t, 4)
+        assert sizes == [200, 200, 200, 200]
+
+    def test_priority_zero_lumps_contiguously(self):
+        # priority 0 closes parts late: first part absorbs until boundary
+        t = shapes((60,), (60,), (60,), (60,))
+        t0 = partition_tensors(t, 2, evenness_priority=0.0)
+        assert t0["p0"] == 0 and t0["p3"] == 1
+
+    def test_all_parts_nonempty_when_enough_tensors(self):
+        t = shapes(*[((i % 7) + 1, 3) for i in range(20)])
+        for e in (0.0, 0.5, 1.0):
+            table = partition_tensors(t, 8, evenness_priority=e)
+            sizes = partition_sizes(table, t, 8)
+            assert all(s > 0 for s in sizes), (e, sizes)
+
+    def test_empty_part_warns(self):
+        t = shapes((4,), (4,))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            partition_tensors(t, 4)
+            assert any("empty" in str(x.message) for x in w)
+
+    def test_ranks_map_sequence_accepted(self):
+        t = shapes((10,), (10,), (10,), (10,))
+        table = partition_tensors(t, [0, 1], evenness_priority=1.0)
+        assert set(table.values()) == {0, 1}
+
+    def test_rejects_bad_priority(self):
+        with pytest.raises(ValueError):
+            partition_tensors(shapes((4,)), 2, evenness_priority=1.5)
+
+    def test_works_on_model_shapes(self):
+        from tiny_deepspeed_tpu import GPTConfig, GPT2Model
+        model = GPT2Model(GPTConfig(n_layer=2, n_head=2, n_embd=32,
+                                    vocab_size=128, block_size=64))
+        table = partition_tensors(model.param_shapes(), 4)
+        assert set(table) == set(model.param_shapes())

@@ -1,0 +1,5 @@
+"""Model zoo: GPT-2 family (parity with reference example/model.py)."""
+
+from .gpt2 import GPTConfig, GPT2Model, GPT2_PRESETS
+
+__all__ = ["GPTConfig", "GPT2Model", "GPT2_PRESETS"]

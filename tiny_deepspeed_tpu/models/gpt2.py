@@ -1,0 +1,207 @@
+"""GPT-2, pure-JAX and TPU-first.
+
+Capability parity with the reference model (example/model.py): GPTConfig
+(:15-25), token+position embeddings, pre-LN transformer blocks with fused-QKV
+causal attention (:53-85), GELU MLP (:89-101), final layernorm, weight-untied
+lm_head, and cross-entropy loss computed inside forward when targets are given
+(:139-157).  The `attn_impl` switch ("standard_attention" | "flash_attention")
+mirrors reference model.py:25,78-81.
+
+Deliberate TPU-first design deltas (this is a re-design, not a port):
+
+  * Parameters are a FLAT, NAME-KEYED dict (ordered), not nn.Module
+    attributes.  Names are stable and sorted insertion order — this is what
+    the partitioner ("cache rank map") and the name-keyed optimizers consume,
+    replacing torch's named_parameters() iteration.
+  * The L transformer blocks are STACKED: each block tensor carries a leading
+    (n_layer,) axis and the forward runs `jax.lax.scan` over it.  One traced
+    block → O(1) compile time in depth (a 48-layer 1.5B model compiles as
+    fast as a 1-layer one), and the stacked axis is a natural target for
+    pipeline/ZeRO sharding.
+  * Linear weights are (in, out) — see ops/linear.py.
+  * Mixed precision is a first-class policy: params live in `param_dtype`
+    (float32) and compute runs in `compute_dtype` (bfloat16 on TPU).  The
+    reference's AMP is an unchecked TODO (reference README.md:68).
+  * Each block is wrapped in `jax.checkpoint` (remat) so the backward
+    re-materializes activations instead of storing 2L of them — the TPU way
+    to trade MXU FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import (
+    linear,
+    layernorm,
+    embedding,
+    standard_attention,
+    flash_attention,
+    softmax_cross_entropy,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Model hyperparameters (parity: reference example/model.py:15-25)."""
+
+    block_size: int = 1024
+    vocab_size: int = 50304  # padded to a multiple of 128 for the MXU
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    attn_impl: str = "flash_attention"  # or "standard_attention"
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+# Named presets covering the BASELINE.md workloads.
+GPT2_PRESETS: Dict[str, GPTConfig] = {
+    "gpt2-124m": GPTConfig(n_layer=12, n_head=12, n_embd=768),
+    "gpt2-350m": GPTConfig(n_layer=24, n_head=16, n_embd=1024),
+    "gpt2-774m": GPTConfig(n_layer=36, n_head=20, n_embd=1280),
+    "gpt2-1.5b": GPTConfig(n_layer=48, n_head=25, n_embd=1600),
+}
+
+
+class GPT2Model:
+    """Functional GPT-2: `init(key) -> params`, `apply(params, idx, targets)`.
+
+    Replaces the reference's nn.Module (example/model.py:125-157).  There is
+    no layer-swap wrapping step (reference zero/utils/wrapper.py:9-36):
+    parallel modes change *shardings and the train step*, never the model
+    code.
+    """
+
+    def __init__(self, config: GPTConfig):
+        self.config = config
+
+    # -- initialization ----------------------------------------------------
+
+    def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        """Shape/dtype pytree without allocating — the TPU equivalent of the
+        reference's meta-device init (reference zero1/train.py:25-27)."""
+        return jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0)))
+
+    def init(self, key) -> Dict[str, jax.Array]:
+        c = self.config
+        d, l, v, t = c.n_embd, c.n_layer, c.vocab_size, c.block_size
+        std = 0.02
+        # GPT-2 init: N(0, 0.02), residual-projection std scaled by 1/sqrt(2L)
+        pstd = std / math.sqrt(2 * l)
+        keys = iter(jax.random.split(key, 16))
+
+        def nrm(k, shape, s):
+            return (jax.random.normal(k, shape, jnp.float32) * s).astype(
+                c.param_dtype
+            )
+
+        def zeros(shape):
+            return jnp.zeros(shape, c.param_dtype)
+
+        params = {
+            "wte": nrm(next(keys), (v, d), std),
+            "wpe": nrm(next(keys), (t, d), std),
+            "h.ln_1.w": jnp.ones((l, d), c.param_dtype),
+            "h.ln_1.b": zeros((l, d)),
+            "h.attn.qkv.w": nrm(next(keys), (l, d, 3 * d), std),
+            "h.attn.qkv.b": zeros((l, 3 * d)),
+            "h.attn.proj.w": nrm(next(keys), (l, d, d), pstd),
+            "h.attn.proj.b": zeros((l, d)),
+            "h.ln_2.w": jnp.ones((l, d), c.param_dtype),
+            "h.ln_2.b": zeros((l, d)),
+            "h.mlp.fc.w": nrm(next(keys), (l, d, 4 * d), std),
+            "h.mlp.fc.b": zeros((l, 4 * d)),
+            "h.mlp.proj.w": nrm(next(keys), (l, 4 * d, d), pstd),
+            "h.mlp.proj.b": zeros((l, d)),
+            "ln_f.w": jnp.ones((d,), c.param_dtype),
+            "ln_f.b": zeros((d,)),
+            # weight-untied lm_head, like the reference (model.py:136-138)
+            "lm_head.w": nrm(next(keys), (d, v), std),
+        }
+        return params
+
+    def num_params(self, params=None) -> int:
+        shapes = params if params is not None else self.param_shapes()
+        return sum(int(math.prod(x.shape)) for x in shapes.values())
+
+    # -- forward -----------------------------------------------------------
+
+    def _block(self, x, bp):
+        """One pre-LN transformer block. x: (B, T, D) in compute_dtype;
+        bp: dict of this block's params (leading layer axis already sliced)."""
+        c = self.config
+        cd = c.compute_dtype
+        b, t, d = x.shape
+
+        h = layernorm(x, bp["ln_1.w"].astype(cd), bp["ln_1.b"].astype(cd))
+        qkv = linear(h, bp["attn.qkv.w"].astype(cd), bp["attn.qkv.b"].astype(cd))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):  # (B, T, D) -> (B, H, T, Dh)
+            return z.reshape(b, t, c.n_head, c.head_dim).swapaxes(1, 2)
+
+        attn = (
+            flash_attention if c.attn_impl == "flash_attention"
+            else standard_attention
+        )
+        y = attn(heads(q), heads(k), heads(v))
+        y = y.swapaxes(1, 2).reshape(b, t, d)
+        y = linear(y, bp["attn.proj.w"].astype(cd), bp["attn.proj.b"].astype(cd))
+        x = x + y
+
+        h = layernorm(x, bp["ln_2.w"].astype(cd), bp["ln_2.b"].astype(cd))
+        h = linear(h, bp["mlp.fc.w"].astype(cd), bp["mlp.fc.b"].astype(cd))
+        h = jax.nn.gelu(h, approximate=True)
+        h = linear(h, bp["mlp.proj.w"].astype(cd), bp["mlp.proj.b"].astype(cd))
+        return x + h
+
+    def apply(self, params, idx, targets: Optional[jax.Array] = None):
+        """Forward pass.  Returns mean loss if targets given, else logits —
+        same contract as reference GPT2Model.forward (model.py:139-157)."""
+        c = self.config
+        cd = c.compute_dtype
+        b, t = idx.shape
+        if t > c.block_size:
+            raise ValueError(
+                f"sequence length {t} > block_size {c.block_size}"
+            )  # reference asserts the same (model.py:142)
+
+        tok = embedding(idx, params["wte"]).astype(cd)
+        pos = params["wpe"][:t].astype(cd)
+        x = tok + pos[None]
+
+        stacked = {
+            k[len("h."):]: v for k, v in params.items() if k.startswith("h.")
+        }
+
+        block = self._block
+        if c.remat:
+            block = jax.checkpoint(block)
+
+        def scan_body(x, bp):
+            return block(x, bp), None
+
+        x, _ = jax.lax.scan(scan_body, x, stacked)
+
+        x = layernorm(x, params["ln_f.w"].astype(cd), params["ln_f.b"].astype(cd))
+
+        if targets is not None:
+            logits = linear(x, params["lm_head.w"].astype(cd), None)
+            return softmax_cross_entropy(logits, targets)
+        # inference path: last position only (cheap lm_head)
+        logits = linear(x[:, -1:], params["lm_head.w"].astype(cd), None)
+        return logits.astype(jnp.float32)
+
+    def __call__(self, params, idx, targets=None):
+        return self.apply(params, idx, targets)

@@ -1,0 +1,56 @@
+"""Op layer: pure differentiable functions with swappable TPU kernels.
+
+Mirrors the reference op surface (tiny_deepspeed/core/module/ops/__init__.py:4-18)
+— linear, layernorm, embedding, conv stubs — but as JAX pure functions with
+`custom_vjp` rules instead of torch autograd.Function pairs.  Each op has:
+
+  * a dispatch wrapper accepting an optional `tuner` (the reference threads a
+    `RuntimeAutoTuner` through every dispatch site, ops/linear.py:9-47);
+  * one or more implementations (XLA-fused baseline; Pallas kernels where a
+    hand kernel wins, replacing the reference's Triton layernorm).
+
+The backward *formulas* are the same closed forms the reference implements
+(linear_input_grad/linear_weight_grad/linear_bias_grad, layernorm_dx/dwdb,
+embedding_weight_grad), but here they exist so parallel engines can rely on a
+stable grad decomposition and the autotuner can swap kernels — XLA still fuses
+through them.
+"""
+
+from .linear import (
+    linear_forward,
+    linear_input_grad,
+    linear_weight_grad,
+    linear_bias_grad,
+    linear,
+)
+from .layernorm import (
+    layernorm_fwd,
+    layernorm_dx,
+    layernorm_dwdb,
+    layernorm,
+)
+from .embedding import (
+    embedding_forward,
+    embedding_weight_grad,
+    embedding,
+)
+from .attention import standard_attention, flash_attention
+from .softmax_xent import softmax_cross_entropy
+
+__all__ = [
+    "linear_forward",
+    "linear_input_grad",
+    "linear_weight_grad",
+    "linear_bias_grad",
+    "linear",
+    "layernorm_fwd",
+    "layernorm_dx",
+    "layernorm_dwdb",
+    "layernorm",
+    "embedding_forward",
+    "embedding_weight_grad",
+    "embedding",
+    "standard_attention",
+    "flash_attention",
+    "softmax_cross_entropy",
+]

@@ -1,0 +1,58 @@
+"""Causal self-attention compute: standard (materialized mask) and flash.
+
+Capability parity with the reference attention switch
+(example/model.py:25,78-81): `GPTConfig.attn_impl` selects between
+`standard_attention` (explicit QK^T + causal mask + softmax, reference
+model.py:29-42) and `flash_attention` (reference wraps
+F.scaled_dot_product_attention, model.py:44-51).
+
+TPU-first expression:
+  * `standard_attention` is plain jnp — XLA fuses mask+softmax into the
+    attention matmuls; logits accumulate in float32.
+  * `flash_attention` prefers the Pallas blockwise kernel
+    (ops/attention_pallas.py) on TPU backends and falls back to
+    `jax.nn.dot_product_attention` / the standard path elsewhere (e.g. the
+    virtual CPU mesh used in tests).
+
+Both take (B, H, T, Dh) tensors, matching the reference's post-split layout
+(reference model.py:72-76).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def standard_attention(q, k, v):
+    """Causal softmax(QK^T/sqrt(d))V with an explicit mask (reference :29-42)."""
+    *_, t, dh = q.shape
+    scale = 1.0 / math.sqrt(dh)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def flash_attention(q, k, v):
+    """Blockwise causal attention; Pallas kernel on TPU, fused XLA elsewhere."""
+    # Static (trace-time) backend choice: tracers carry no device, and the
+    # kernel choice must be baked into the jitted program anyway.
+    if jax.default_backend() == "tpu":
+        try:
+            from .attention_pallas import pallas_flash_attention
+        except ImportError:
+            pallas_flash_attention = None
+        if pallas_flash_attention is not None:
+            return pallas_flash_attention(q, k, v)
+    try:
+        return jax.nn.dot_product_attention(
+            q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), is_causal=True
+        ).swapaxes(1, 2)
+    except Exception:
+        return standard_attention(q, k, v)

@@ -1,0 +1,24 @@
+"""Conv op stubs — mirrored from the reference, which never implemented them.
+
+The reference ships empty conv files (ops/conv1d.py, conv2d.py, conv3d.py and
+module/conv.py each contain only a license header — reference §2.6).  We keep
+the same surface so the inventories line up, but raise explicitly instead of
+silently exporting nothing.
+"""
+
+from __future__ import annotations
+
+
+def _not_implemented(name):
+    def fn(*args, **kwargs):
+        raise NotImplementedError(
+            f"{name} is a stub, mirroring the reference's empty "
+            "ops/conv{1,2,3}d.py (license headers only, never implemented)."
+        )
+    fn.__name__ = name
+    return fn
+
+
+conv1d_forward = _not_implemented("conv1d_forward")
+conv2d_forward = _not_implemented("conv2d_forward")
+conv3d_forward = _not_implemented("conv3d_forward")

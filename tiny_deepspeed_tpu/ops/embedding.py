@@ -1,0 +1,61 @@
+"""Embedding op: gather forward, scatter-add weight grad.
+
+Capability parity with reference ops/embedding.py (dispatch:11-31, forward via
+index_select:34-58, weight grad via index_add_:60-65, optional max_norm renorm
+:67-68).  TPU-first expression:
+
+  * forward is `jnp.take` (a gather XLA lays out well on TPU);
+  * the weight gradient is a scatter-add (`zeros.at[idx].add(gy)`), the XLA
+    equivalent of torch's index_add_;
+  * `max_norm` renormalization is supported functionally: it returns the
+    renormalized table rather than mutating in place (the reference mutates
+    the live weight, ops/embedding.py:67-68 — impossible and undesirable in
+    a functional graph).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def embedding_forward(idx, w, tuner=None):
+    """y[..., d] = w[idx]; idx integer array, w[(vocab, d)]."""
+    return jnp.take(w, idx, axis=0)
+
+
+def embedding_weight_grad(gy, idx, vocab_size, tuner=None):
+    """dw[v, d] = sum over positions p with idx[p]==v of gy[p, d]."""
+    d = gy.shape[-1]
+    flat_idx = idx.reshape(-1)
+    flat_gy = gy.reshape(-1, d).astype(jnp.float32)
+    dw = jnp.zeros((vocab_size, d), jnp.float32).at[flat_idx].add(flat_gy)
+    return dw.astype(gy.dtype)
+
+
+def renorm_weight(w, max_norm, norm_type=2.0):
+    """Return w with rows scaled so ||row||_p <= max_norm (reference :67-68)."""
+    norms = jnp.linalg.norm(w.astype(jnp.float32), ord=norm_type, axis=-1,
+                            keepdims=True)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-12))
+    return (w.astype(jnp.float32) * scale).astype(w.dtype)
+
+
+@jax.custom_vjp
+def embedding(idx, w):
+    return embedding_forward(idx, w)
+
+
+def _embedding_fwd_rule(idx, w):
+    return embedding_forward(idx, w), (idx, w.shape[0])
+
+
+def _embedding_bwd_rule(res, gy):
+    idx, vocab = res
+    # Integer primal -> float0 cotangent (JAX's "no gradient" for int inputs).
+    import numpy as np
+    zero = np.zeros(idx.shape, dtype=jax.dtypes.float0)
+    return zero, embedding_weight_grad(gy, idx, vocab)
+
+
+embedding.defvjp(_embedding_fwd_rule, _embedding_bwd_rule)

@@ -1,0 +1,104 @@
+"""Fused LayerNorm: forward saves (mean, rstd); backward = dx + (dw, db).
+
+Capability parity with the reference's one hand-written kernel — the Triton
+fused layernorm (reference ops/layernorm.py: fwd kernel :158-207, dx kernel
+with spin-lock partial dw/db accumulation :210-269, final dwdb reduction
+:272-298).  The two-stage lock/atomics reduction is a GPU artifact; on TPU the
+same math is a per-row fused normalization plus a grid reduction, provided
+here as:
+
+  * an XLA-fused baseline (`_ln_fwd_xla` / `_ln_bwd_xla`) — jnp code that XLA
+    fuses into one pass per direction;
+  * a Pallas kernel variant (ops/layernorm_pallas.py), selected through the
+    same dispatch seam via the autotuner.
+
+Restrictions match the reference module layer: affine weight AND bias are
+required, and normalization is over the last dim only (reference
+module/normalization.py:36-38, 62-63).
+
+Like the reference, forward returns (y, mean, rstd) so backward avoids
+recomputing row statistics (reference ops/layernorm.py:195-196); accumulation
+is float32 regardless of input dtype (reference keeps a supported-accumulation
+table, ops/utils.py:13-16).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def layernorm_fwd(x, w, b, eps=1e-5, tuner=None):
+    """Returns (y, mean, rstd); mean/rstd are float32 with shape x.shape[:-1]."""
+    impl = tuner.choose(_CANDIDATES_FWD, (x, w, b)) if tuner else _ln_fwd_xla
+    return impl(x, w, b, eps)
+
+
+def _ln_fwd_xla(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1)
+    var = jnp.mean(jnp.square(xf), axis=-1) - jnp.square(mean)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (xf - mean[..., None]) * rstd[..., None]
+    y = xhat * w.astype(jnp.float32) + b.astype(jnp.float32)
+    return y.astype(x.dtype), mean, rstd
+
+
+def layernorm_dx(gy, x, w, mean, rstd, tuner=None):
+    """dx for y = xhat*w + b, using saved row stats.
+
+    Same decomposition as the reference dx kernel (ops/layernorm.py:210-255):
+      dxhat = gy * w
+      dx    = rstd * (dxhat - mean(dxhat) - xhat * mean(dxhat * xhat))
+    """
+    n = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    gyf = gy.astype(jnp.float32)
+    xhat = (xf - mean[..., None]) * rstd[..., None]
+    dxhat = gyf * w.astype(jnp.float32)
+    c1 = jnp.sum(dxhat, axis=-1, keepdims=True) / n
+    c2 = jnp.sum(dxhat * xhat, axis=-1, keepdims=True) / n
+    dx = (dxhat - c1 - xhat * c2) * rstd[..., None]
+    return dx.astype(x.dtype)
+
+
+def layernorm_dwdb(gy, x, mean, rstd, tuner=None):
+    """(dw, db) reduced over all leading dims (reference ops/layernorm.py:272-298)."""
+    xf = x.astype(jnp.float32)
+    gyf = gy.astype(jnp.float32)
+    xhat = (xf - mean[..., None]) * rstd[..., None]
+    axes = tuple(range(gy.ndim - 1))
+    dw = jnp.sum(gyf * xhat, axis=axes)
+    db = jnp.sum(gyf, axis=axes)
+    return dw.astype(x.dtype), db.astype(x.dtype)
+
+
+_CANDIDATES_FWD = [_ln_fwd_xla]
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper
+# ---------------------------------------------------------------------------
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layernorm(x, w, b, eps=1e-5):
+    y, _, _ = layernorm_fwd(x, w, b, eps)
+    return y
+
+
+def _layernorm_fwd_rule(x, w, b, eps):
+    y, mean, rstd = layernorm_fwd(x, w, b, eps)
+    return y, (x, w, mean, rstd)
+
+
+def _layernorm_bwd_rule(eps, res, gy):
+    x, w, mean, rstd = res
+    dx = layernorm_dx(gy, x, w, mean, rstd)
+    dw, db = layernorm_dwdb(gy, x, mean, rstd)
+    return dx, dw, db
+
+
+layernorm.defvjp(_layernorm_fwd_rule, _layernorm_bwd_rule)

@@ -1,0 +1,24 @@
+"""Softmax cross-entropy with integer targets, computed in float32.
+
+The reference computes loss inside the model forward with
+F.cross_entropy(logits.view(-1, V), targets.view(-1)) (reference
+example/model.py:154-156).  This is the TPU equivalent: a numerically stable
+log-softmax gather, mean-reduced over all positions.  Kept as a standalone op
+so the lm_head matmul + loss can later be fused/blocked (the (B*T, 50304)
+logits tensor dominates HBM traffic at small batch).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, targets):
+    """Mean NLL.  logits (..., V) any float dtype; targets (...) int."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
+    ).squeeze(-1)
+    return jnp.mean(logz - gold)

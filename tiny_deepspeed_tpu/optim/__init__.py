@@ -1,0 +1,14 @@
+"""Name-keyed pytree optimizers (parity: reference core/optim/__init__.py:5-6).
+
+The sharded variants (DDPSGD/Zero1AdamW/... in the reference,
+core/__init__.py:5-21) do not exist as separate classes here: sharding the
+optimizer is a *placement* decision made by the parallel engine (the same
+`update` runs under pjit with sharded state), not a re-derived class.  See
+parallel/engine.py.
+"""
+
+from .base import Optimizer
+from .sgd import SGD
+from .adamw import AdamW
+
+__all__ = ["Optimizer", "SGD", "AdamW"]

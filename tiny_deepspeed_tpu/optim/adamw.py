@@ -1,0 +1,69 @@
+"""AdamW (reference-semantics documented, quirks fixed).
+
+Parity with reference core/optim/adamw.py:10-59, with two deliberate
+deviations recorded in the quirk ledger (SURVEY §8):
+
+  * Reference quirk #3: weight decay is L2-folded into the gradient
+    (`grad += wd * param`, reference adamw.py:37-38) — i.e. Adam-with-L2, not
+    decoupled AdamW, despite the name.  We default to the same math
+    (`decoupled=False`) so loss trajectories are comparable, and offer true
+    decoupled AdamW behind `decoupled=True`.
+  * Reference quirk #2: `self.t += 1` per *parameter* inside one_step
+    (adamw.py:59), so bias correction decays ~n_params× too fast.  That is a
+    bug, not a semantic: we keep ONE global step counter.  (A faithful
+    emulation would make bias correction vanish after the first iteration —
+    measurably worse convergence for no capability.)
+
+amsgrad is supported (reference adamw.py:50-53).  All state math runs in
+float32 regardless of param dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Optimizer
+
+
+class AdamW(Optimizer):
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=1e-2, amsgrad=False, maximize=False,
+                 decoupled=False):
+        super().__init__(lr)
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.amsgrad = amsgrad
+        self.maximize = maximize
+        self.decoupled = decoupled
+
+    def init_one(self, name, param):
+        z = jnp.zeros(param.shape, jnp.float32)
+        state = {"m": z, "v": z}
+        if self.amsgrad:
+            state["vmax"] = z
+        return state
+
+    def update_one(self, name, param, grad, state, step):
+        g = grad.astype(jnp.float32)
+        p = param.astype(jnp.float32)
+        if self.maximize:
+            g = -g
+        if self.weight_decay and not self.decoupled:
+            g = g + self.weight_decay * p  # reference adamw.py:37-38
+        m = self.b1 * state["m"] + (1.0 - self.b1) * g
+        v = self.b2 * state["v"] + (1.0 - self.b2) * jnp.square(g)
+        t = step.astype(jnp.float32)
+        mhat = m / (1.0 - jnp.power(self.b1, t))
+        if self.amsgrad:
+            vmax = jnp.maximum(state["vmax"], v)
+            vhat = vmax / (1.0 - jnp.power(self.b2, t))
+            new_state = {"m": m, "v": v, "vmax": vmax}
+        else:
+            vhat = v / (1.0 - jnp.power(self.b2, t))
+            new_state = {"m": m, "v": v}
+        upd = mhat / (jnp.sqrt(vhat) + self.eps)
+        if self.weight_decay and self.decoupled:
+            upd = upd + self.weight_decay * p
+        new_p = p - self.lr * upd
+        return new_p.astype(param.dtype), new_state

@@ -1,0 +1,53 @@
+"""Optimizer base: pure, name-keyed, pytree-native.
+
+Parity with reference core/optim/base.py:7-26 — a dict-of-named-params
+optimizer whose `step()` loops `one_step(name, param)` — re-expressed
+functionally: `init(params) -> state`, `update(params, grads, state) ->
+(new_params, new_state)`.  The per-name loop still exists (it is how
+per-parameter hyperparameters and the cache-rank-map interact with the
+optimizer) but it is a *trace-time* Python loop over dict entries: XLA sees
+one fused update graph, not ~75 sequential kernel launches like the
+reference's hot python loop (reference base.py:15-20, SURVEY §3.1).
+
+Grad zeroing (reference base.py:25-26 sets .grad=None) has no functional
+equivalent — grads are consumed by value; "zeroing" is simply not reusing
+them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+
+
+class Optimizer:
+    """Subclasses implement `init_one` and `update_one` per named param."""
+
+    def __init__(self, lr: float):
+        self.lr = lr
+
+    # -- per-parameter hooks ----------------------------------------------
+
+    def init_one(self, name: str, param) -> Dict:
+        """Return this param's state dict (e.g. {'m': ..., 'v': ...})."""
+        raise NotImplementedError
+
+    def update_one(self, name: str, param, grad, state: Dict, step):
+        """Return (new_param, new_state).  Must be pure/traceable."""
+        raise NotImplementedError
+
+    # -- pytree API --------------------------------------------------------
+
+    def init(self, params: Dict) -> Dict:
+        per_param = {n: self.init_one(n, p) for n, p in params.items()}
+        return {"step": jax.numpy.zeros((), jax.numpy.int32), "state": per_param}
+
+    def update(self, params: Dict, grads: Dict, opt_state: Dict):
+        step = opt_state["step"] + 1
+        new_params, new_state = {}, {}
+        for n, p in params.items():
+            new_params[n], new_state[n] = self.update_one(
+                n, p, grads[n], opt_state["state"][n], step
+            )
+        return new_params, {"step": step, "state": new_state}

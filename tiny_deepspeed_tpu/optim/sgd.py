@@ -1,0 +1,51 @@
+"""SGD with momentum/dampening/nesterov/weight-decay/maximize.
+
+Parity with reference core/optim/sgd.py:10-46: weight decay folded into the
+gradient (:30-31), maximize flag (:33-34), classic momentum with dampening and
+nesterov (:36-43), momentum buffers keyed by param name (:23-26).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .base import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, lr=1e-3, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False, maximize=False):
+        super().__init__(lr)
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("nesterov requires momentum > 0 and zero dampening")
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.maximize = maximize
+
+    def init_one(self, name, param):
+        if self.momentum:
+            return {"velocity": jnp.zeros_like(param)}
+        return {}
+
+    def update_one(self, name, param, grad, state, step):
+        g = grad.astype(jnp.float32)
+        p = param.astype(jnp.float32)
+        if self.weight_decay:
+            g = g + self.weight_decay * p
+        if self.maximize:
+            g = -g
+        new_state = state
+        if self.momentum:
+            # Reference semantics (sgd.py:23-26, 36-43): velocity zero-init,
+            # always v = momentum*v + (1-dampening)*g — so the FIRST step
+            # applies (1-dampening)*g, unlike torch's buf=grad special case.
+            buf = (
+                self.momentum * state["velocity"].astype(jnp.float32)
+                + (1.0 - self.dampening) * g
+            )
+            new_state = {"velocity": buf.astype(param.dtype)}
+            g = g + self.momentum * buf if self.nesterov else buf
+        new_p = p - self.lr * g
+        return new_p.astype(param.dtype), new_state

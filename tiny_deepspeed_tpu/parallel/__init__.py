@@ -1,0 +1,24 @@
+"""Parallelism layer: mesh, partitioner ("cache rank map"), ZeRO engines.
+
+Replaces the reference's zero/{ddp,zero1,zero2,zero3} packages
+(reference core/__init__.py:5-23).  Where the reference re-derives every
+module per mode to inject NCCL calls into backward callbacks, here a single
+model runs under different *sharding strategies*; the collectives are XLA
+collectives chosen by the compiler from NamedSharding constraints.
+"""
+
+from .partition import partition_tensors
+from .mesh import make_mesh, init_distributed
+from .engine import SingleDevice, DDP, Zero1, Zero2, Zero3, TrainState
+
+__all__ = [
+    "partition_tensors",
+    "make_mesh",
+    "init_distributed",
+    "SingleDevice",
+    "DDP",
+    "Zero1",
+    "Zero2",
+    "Zero3",
+    "TrainState",
+]

@@ -1,0 +1,325 @@
+"""ZeRO engines: DDP / ZeRO-1 / ZeRO-2 / ZeRO-3 as sharding strategies.
+
+This file replaces the reference's entire zero/{ddp,zero1,zero2,zero3}
+package family (wrapper.py + module.py + optim.py + utils.py per mode,
+reference core/zero/) — ~1,100 LoC of per-mode re-derived modules injecting
+NCCL calls into backward callbacks — with ONE engine parameterized by a
+sharding strategy.  The mapping:
+
+  reference mechanism                        TPU-native expression here
+  -----------------------------------------  --------------------------------
+  DDP: per-param async all-reduce in bwd      batch sharded over mesh "data";
+  callback + wait (ddp/module.py:36-78)       params replicated -> XLA emits
+                                              the grad all-reduce and overlaps
+                                              it with the dx matmuls (latency-
+                                              hiding scheduler).
+  ZeRO-1: grad reduce-to-owner + owner        optimizer state laid out sharded
+  steps + param broadcast                     (NamedSharding); update compute
+  (zero1/module.py:17-24, optim.py:25-34)     partitions to the shard, new
+                                              params constrained replicated ->
+                                              all-gather.
+  ZeRO-2: + non-owner grads dropped           grads constrained to the sharded
+  (zero2/module.py:26-36 — a 1-elem           spec right after value_and_grad
+  placeholder hack, "impossible in            -> XLA turns the all-reduce into
+  pytorch, maybe solved by plugin C++")       reduce-scatter; full grads never
+                                              materialize.  The hack vanishes.
+  ZeRO-3: params broadcast-on-demand per      params *live* sharded; the scan
+  layer, broken in the reference              over stacked blocks slices one
+  (zero3/module.py:17-46, SURVEY §2.18:       layer then XLA all-gathers just
+  NameError, rank-0 falsy, frees discarded)   that layer's shards inside the
+                                              loop (fwd and, via remat, bwd) —
+                                              the design the reference
+                                              attempted, but correct.
+  per-param `bwd_sync` grad-accum gating      explicit microbatch axis +
+  (ddp/wrapper.py:25-33)                      lax.scan accumulation; collective
+                                              cost paid once per step.
+  cache rank map placement                    partition_tensors table exposed
+  (zero/utils/partition.py)                   as `engine.rank_map` (ownership
+                                              report / API parity); physical
+                                              layout is even axis-sharding
+                                              (SPMD) — see partition.py note.
+
+Quirk decisions (SURVEY §8): reference DDP *sums* grads across ranks and never
+divides (quirk #1); here the loss is the mean over the GLOBAL batch, so grads
+are the true global gradient — DDP-vs-single-device parity becomes exact
+instead of lr-rescaled.  Recorded in tests/test_parity.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import make_mesh
+from .partition import partition_tensors
+
+try:
+    from flax import struct as _struct
+
+    @_struct.dataclass
+    class TrainState:
+        params: Dict[str, Any]
+        opt_state: Dict[str, Any]
+except Exception:  # pragma: no cover - flax always present in this image
+    TrainState = None
+
+
+# ---------------------------------------------------------------------------
+# sharding rules
+# ---------------------------------------------------------------------------
+
+def _leaf_spec(name: str, shape, n_dev: int, axis: str = "data") -> P:
+    """Even axis-sharding rule for one tensor.
+
+    Shard the largest axis divisible by the mesh size; tensors from the
+    stacked block ("h.*") never shard the leading (n_layer,) axis — the scan
+    slices it, and keeping it unsharded is what makes XLA's all-gather happen
+    per-layer *inside* the loop (the ZeRO-3 gather-on-demand).  Indivisible /
+    small tensors replicate.
+    """
+    if not shape:
+        return P()
+    start = 1 if name.startswith("h.") and len(shape) > 1 else 0
+    best = None
+    for ax in range(start, len(shape)):
+        if shape[ax] % n_dev == 0 and shape[ax] >= n_dev:
+            if best is None or shape[ax] > shape[best]:
+                best = ax
+    if best is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = axis
+    return P(*spec)
+
+
+def _param_spec_tree(shapes: Dict[str, Any], n_dev: int) -> Dict[str, P]:
+    return {n: _leaf_spec(n, s.shape, n_dev) for n, s in shapes.items()}
+
+
+def _opt_spec_tree(opt_shapes, param_specs: Dict[str, P], sharded: bool):
+    """Sharding tree matching the optimizer-state structure.
+
+    Per-param slots (m/v/velocity/vmax, shaped like the param) inherit the
+    param's spec when `sharded`; the global step counter replicates.
+    """
+    def spec_for(path, leaf):
+        if not sharded:
+            return P()
+        names = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
+        # path looks like ('state', '<param name>', 'm')
+        for key in names:
+            if key in param_specs and len(param_specs[key]) == len(leaf.shape):
+                return param_specs[key]
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, opt_shapes)
+
+
+def _to_shardings(tree_of_specs, mesh: Mesh):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        tree_of_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+class ZeroEngine:
+    """Training engine; subclasses pin the ZeRO stage.
+
+    API parity with the reference wrappers + sharded optimizers
+    (e.g. `Zero2(model, partition_table)` + `Zero2AdamW(...)`,
+    reference zero2/wrapper.py:16-48, zero2/optim.py): here the pair is
+    fused — `Zero2(model, optimizer, mesh).init(key)` then
+    `state, loss = engine.step(state, batch)`.
+    """
+
+    stage: int = 0
+    data_parallel: bool = True
+
+    def __init__(
+        self,
+        model,
+        optimizer,
+        mesh: Optional[Mesh] = None,
+        accum_steps: int = 1,
+        evenness_priority: float = 0.0,
+        donate: bool = True,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        if mesh is None:
+            mesh = (
+                make_mesh()
+                if self.data_parallel
+                else make_mesh(devices=[jax.devices()[0]])
+            )
+        self.mesh = mesh
+        self.accum_steps = int(accum_steps)
+        self.n_dev = mesh.devices.size
+
+        shapes = model.param_shapes()
+        # API-parity ownership table (the reference's cache rank map).
+        self.rank_map = partition_tensors(
+            shapes, self.n_dev, evenness_priority
+        )
+
+        specs = _param_spec_tree(shapes, self.n_dev)
+        self._shard_spec = specs  # even-shard spec per param
+        self._shard_shardings = _to_shardings(specs, mesh)
+        rep = {n: P() for n in specs}
+        # where params LIVE between steps
+        self._param_spec_rest = specs if self.stage >= 3 else rep
+        self._param_shardings = _to_shardings(self._param_spec_rest, mesh)
+
+        opt_shapes = jax.eval_shape(optimizer.init, shapes)
+        opt_specs = _opt_spec_tree(opt_shapes, specs, sharded=self.stage >= 1)
+        self._opt_shardings = _to_shardings(opt_specs, mesh)
+
+        batch_spec = P("data") if self.data_parallel else P()
+        if self.accum_steps > 1:
+            batch_spec = P(None, *batch_spec)
+        self._batch_sharding = NamedSharding(mesh, batch_spec)
+
+        self._step = jax.jit(
+            self._step_impl,
+            in_shardings=(
+                TrainState(
+                    params=self._param_shardings,
+                    opt_state=self._opt_shardings,
+                ),
+                (self._batch_sharding, self._batch_sharding),
+            ),
+            out_shardings=(
+                TrainState(
+                    params=self._param_shardings,
+                    opt_state=self._opt_shardings,
+                ),
+                NamedSharding(mesh, P()),
+            ),
+            donate_argnums=(0,),
+        )
+
+    # -- state creation ----------------------------------------------------
+
+    def init(self, key) -> "TrainState":
+        """Create params + optimizer state directly in their resting
+        shardings (no full-replica materialization step — fixes the
+        reference's full `.to(rank)` before wrapping, zero1/train.py:34)."""
+        params = jax.jit(
+            self.model.init, out_shardings=self._param_shardings
+        )(key)
+        opt_state = jax.jit(
+            self.optimizer.init, out_shardings=self._opt_shardings
+        )(params)
+        return TrainState(params=params, opt_state=opt_state)
+
+    # -- the train step ----------------------------------------------------
+
+    @staticmethod
+    def _constrain(tree, shardings):
+        return jax.tree.map(
+            jax.lax.with_sharding_constraint, tree, shardings
+        )
+
+    def _step_impl(self, state: "TrainState", batch):
+        idx, targets = batch
+        params = state.params
+
+        def loss_fn(p, ix, tg):
+            return self.model.apply(p, ix, tg)
+
+        if self.accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, idx, targets)
+        else:
+            # Microbatch accumulation: batch is (accum, B, T); grads summed
+            # locally across microbatches, collective cost paid once — the
+            # reference's `require_backward_grad_sync` gating
+            # (ddp/wrapper.py:25-33) as explicit loop semantics.
+            def body(carry, mb):
+                acc_loss, acc_grads = carry
+                ix, tg = mb
+                l, g = jax.value_and_grad(loss_fn)(params, ix, tg)
+                acc_grads = jax.tree.map(
+                    lambda a, b: a + b.astype(jnp.float32), acc_grads, g
+                )
+                return (acc_loss + l, acc_grads), None
+
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (loss, grads), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zero_grads), (idx, targets)
+            )
+            loss = loss / self.accum_steps
+            grads = jax.tree.map(
+                lambda g, p: (g / self.accum_steps).astype(p.dtype),
+                grads, params,
+            )
+
+        if self.stage >= 2:
+            # ZeRO-2/3: gradient sharding — the all-reduce XLA would emit for
+            # replicated-param grads becomes a reduce-scatter.
+            grads = self._constrain(grads, self._shard_shardings)
+
+        new_params, new_opt = self.optimizer.update(
+            params, grads, state.opt_state
+        )
+        # ZeRO-1/2: updated params all-gather back to replicated; ZeRO-3:
+        # they stay sharded.  (The reference broadcasts per-param from the
+        # owner in a python loop with no bucketing, zero1/optim.py:25-34.)
+        new_params = self._constrain(new_params, self._param_shardings)
+        return TrainState(params=new_params, opt_state=new_opt), loss
+
+    def step(self, state, batch):
+        """One optimizer step.  batch = (idx, targets), each (B, T) int32 —
+        or (accum, B, T) when accum_steps > 1."""
+        return self._step(state, batch)
+
+    # -- reporting ---------------------------------------------------------
+
+    def describe(self) -> str:
+        name = type(self).__name__
+        return (
+            f"{name}(stage={self.stage}, devices={self.n_dev}, "
+            f"accum={self.accum_steps}, params sharded="
+            f"{self.stage >= 3}, grads sharded={self.stage >= 2}, "
+            f"opt state sharded={self.stage >= 1})"
+        )
+
+
+class SingleDevice(ZeroEngine):
+    """Stage-0, one device (reference example/single_device/train.py)."""
+    stage = 0
+    data_parallel = False
+
+
+class DDP(ZeroEngine):
+    """Replicated params, sharded batch, all-reduced grads
+    (reference ddp/wrapper.py:15-33)."""
+    stage = 0
+
+
+class Zero1(ZeroEngine):
+    """+ optimizer state sharded (reference zero1/)."""
+    stage = 1
+
+
+class Zero2(ZeroEngine):
+    """+ gradients sharded via reduce-scatter (reference zero2/)."""
+    stage = 2
+
+
+class Zero3(ZeroEngine):
+    """+ parameters sharded at rest, gathered per-layer on demand
+    (reference zero3/ — completed here; the reference's is broken,
+    SURVEY §2.18)."""
+    stage = 3

@@ -1,0 +1,81 @@
+"""Device mesh construction + multi-host initialization.
+
+Replaces the reference's process-group bring-up
+(`dist.init_process_group('nccl', init_method='env://')`, reference
+example/ddp/train.py:19, torchrun rendezvous) with the TPU equivalents:
+
+  * `init_distributed()` — `jax.distributed.initialize()` when running
+    multi-host (a no-op on one host).  The reference is single-node only
+    (README.md:70 TODO "multi-node"); this framework is multi-host-safe from
+    the start: the same mesh code spans ICI within a slice and DCN across
+    slices.
+  * `make_mesh(axis_names=..., shape=...)` — a `jax.sharding.Mesh` over all
+    visible devices.  Axis convention:
+        "data"  — batch / ZeRO sharding axis (always present)
+        "model" — tensor-parallel axis (optional)
+        "seq"   — sequence/context parallel axis (optional, ring attention)
+    Collectives ride ICI because mesh axes are laid out over the physical
+    device order jax exposes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_distributed(**kwargs) -> None:
+    """Multi-host bring-up.  Safe to call unconditionally, BEFORE any other
+    JAX backend use (like the reference calls init_process_group first,
+    ddp/train.py:19 — torchrun env:// rendezvous becomes
+    jax.distributed.initialize auto-configuration on Cloud TPU).
+
+    Single-process runs (no multi-host env, no kwargs, not on a pod) skip
+    initialization — jax.distributed.initialize would otherwise block
+    waiting for a coordinator.
+    """
+    if jax.distributed.is_initialized():
+        return
+    multi_host_env = any(
+        os.environ.get(v)
+        for v in (
+            "JAX_COORDINATOR_ADDRESS",     # explicit coordinator
+            "COORDINATOR_ADDRESS",
+            "TPU_WORKER_HOSTNAMES",        # Cloud TPU pod runtime
+            "MEGASCALE_COORDINATOR_ADDRESS",
+        )
+    ) or kwargs
+    single = os.environ.get("TPU_WORKER_HOSTNAMES", "localhost") in (
+        "localhost", "127.0.0.1", ""
+    ) and not (kwargs or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if multi_host_env and not single:
+        jax.distributed.initialize(**kwargs)
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Tuple[str, ...] = ("data",),
+    devices=None,
+) -> Mesh:
+    """Mesh over all devices; default one "data" axis spanning everything."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (devices.size,) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(shape)) != devices.size:
+        raise ValueError(
+            f"mesh shape {tuple(shape)} != device count {devices.size}"
+        )
+    return Mesh(devices.reshape(shape), axis_names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis."""
+    return NamedSharding(mesh, P(axis))
