@@ -1,0 +1,80 @@
+"""RuntimeAutoTuner: caching, freezing, fallback on failing candidates."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tiny_deepspeed_tpu.autotuner import (
+    RuntimeAutoTuner,
+    get_default_tuner,
+    set_default_tuner,
+)
+
+
+def fast(x):
+    return x + 1.0
+
+
+def slow(x):
+    y = x
+    for _ in range(200):
+        y = jnp.sin(y)
+    return y + (x + 1.0) - y  # same-ish magnitude, much slower
+
+
+def broken(x):
+    raise ValueError("unsupported shapes")
+
+
+class TestRuntimeAutoTuner:
+    def test_picks_and_caches(self):
+        t = RuntimeAutoTuner(warmup=1, iters=2)
+        x = jnp.ones((256, 256))
+        winner = t.choose([slow, fast], (x,))
+        assert winner in (slow, fast)
+        assert len(t.cache) == 1
+        # cached: same key returns identical object without re-timing
+        assert t.choose([slow, fast], (x,)) is winner
+
+    def test_single_candidate_shortcut(self):
+        t = RuntimeAutoTuner()
+        assert t.choose([fast], (jnp.ones((4, 4)),)) is fast
+        assert not t.cache  # no timing, no cache entry
+
+    def test_distinct_shapes_distinct_keys(self):
+        t = RuntimeAutoTuner(warmup=1, iters=1)
+        t.choose([slow, fast], (jnp.ones((64, 64)),))
+        t.choose([slow, fast], (jnp.ones((128, 64)),))
+        assert len(t.cache) == 2
+
+    def test_freeze_stops_timing(self):
+        t = RuntimeAutoTuner(warmup=1, iters=1)
+        t.final_tune()
+        out = t.choose([slow, fast], (jnp.ones((32, 32)),))
+        assert out is slow  # frozen: first candidate, no timing
+        assert not t.cache
+
+    def test_broken_candidate_survives(self):
+        t = RuntimeAutoTuner(warmup=1, iters=1)
+        winner = t.choose([broken, fast], (jnp.ones((16, 16)),))
+        assert winner is fast
+
+    def test_none_args_tolerated(self):
+        t = RuntimeAutoTuner(warmup=1, iters=1)
+        two = lambda x, b: x * 2  # noqa: E731
+        three = lambda x, b: x * 3  # noqa: E731
+        w = t.choose([two, three], (jnp.ones((8, 8)), None))
+        assert w in (two, three)
+
+    def test_default_tuner_roundtrip(self):
+        assert get_default_tuner() is None
+        t = RuntimeAutoTuner()
+        set_default_tuner(t)
+        try:
+            assert get_default_tuner() is t
+        finally:
+            set_default_tuner(None)
+
+    def test_reference_alias(self):
+        # reference API name choose_function (runtime_tuner.py:16)
+        t = RuntimeAutoTuner(warmup=1, iters=1)
+        assert t.choose_function([fast], (jnp.ones((4, 4)),)) is fast

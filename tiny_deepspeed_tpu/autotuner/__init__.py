@@ -1,0 +1,5 @@
+"""Runtime autotuner (parity: reference core/autotuner/__init__.py:3)."""
+
+from .runtime_tuner import RuntimeAutoTuner, get_default_tuner, set_default_tuner
+
+__all__ = ["RuntimeAutoTuner", "get_default_tuner", "set_default_tuner"]

@@ -1,0 +1,131 @@
+"""RuntimeAutoTuner: measure candidate kernels, cache the winner per shape.
+
+Capability parity with reference core/autotuner/runtime_tuner.py:7-39
+(choose_function times each candidate with warmup+measured wall-clock calls
+and caches the winner; final_tune freezes the choice), re-thought for XLA's
+compilation model:
+
+  * The reference times eagerly inside forward() because torch dispatches op
+    by op.  Under jit everything is traced once — so candidates are timed at
+    TRACE TIME: when `choose` is called with tracers, the tuner synthesizes
+    concrete arrays of the same shape/dtype, jits each candidate, times it on
+    the real device, and bakes the winner into the traced program.  Each
+    (candidates, shapes, dtypes) key is timed once per process and cached.
+  * Timing uses a device->host transfer as the sync barrier
+    (block_until_ready is unreliable on the axon tunnel platform).
+  * `final_tune()` freezes the cache (parity: reference :31-32): after
+    freezing, unseen keys fall back to candidate[0] instead of timing.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RuntimeAutoTuner:
+    def __init__(self, warmup: int = 2, iters: int = 5, verbose: bool = False):
+        self.warmup = warmup
+        self.iters = iters
+        self.verbose = verbose
+        self.cache: Dict[Tuple, Callable] = {}
+        self.frozen = False
+
+    # -- key / input synthesis --------------------------------------------
+
+    @staticmethod
+    def _key(candidates: Sequence[Callable], args) -> Tuple:
+        sig = tuple(
+            None if a is None else (tuple(a.shape), str(a.dtype))
+            for a in args
+        )
+        return (tuple(c.__module__ + "." + c.__name__ for c in candidates), sig)
+
+    @staticmethod
+    def _synthesize(args):
+        """Concrete stand-ins for (possibly traced) args, same shape/dtype."""
+        out = []
+        key = jax.random.PRNGKey(0)
+        for a in args:
+            if a is None:
+                out.append(None)
+            elif jnp.issubdtype(a.dtype, jnp.integer):
+                out.append(jnp.zeros(a.shape, a.dtype))
+            else:
+                key, sub = jax.random.split(key)
+                out.append(jax.random.normal(sub, a.shape, jnp.float32)
+                           .astype(a.dtype))
+        return tuple(out)
+
+    def _time_one(self, fn: Callable, concrete, static_kwargs) -> float:
+        jitted = jax.jit(lambda *xs: fn(*xs, **static_kwargs))
+        try:
+            for _ in range(self.warmup):
+                r = jitted(*concrete)
+            jax.tree.map(
+                lambda x: np.asarray(jax.tree.leaves(x)[0].ravel()[0:1]), r
+            )
+            t0 = time.perf_counter()
+            for _ in range(self.iters):
+                r = jitted(*concrete)
+            # device->host sync on one element of one output
+            np.asarray(jax.tree.leaves(r)[0].ravel()[0:1])
+            return (time.perf_counter() - t0) / self.iters
+        except Exception as e:  # candidate doesn't support these shapes
+            if self.verbose:
+                print(f"autotuner: {fn.__name__} failed: {type(e).__name__}")
+            return float("inf")
+
+    # -- public API --------------------------------------------------------
+
+    def choose(self, candidates: Sequence[Callable], args,
+               **static_kwargs) -> Callable:
+        """Pick the fastest candidate for these arg shapes (cached)."""
+        candidates = list(candidates)
+        if len(candidates) == 1:
+            return candidates[0]
+        key = self._key(candidates, args)
+        if key in self.cache:
+            return self.cache[key]
+        if self.frozen:
+            return candidates[0]
+        concrete = self._synthesize(args)
+        times = [self._time_one(c, concrete, static_kwargs)
+                 for c in candidates]
+        best = int(np.argmin(times))
+        if times[best] == float("inf"):
+            best = 0
+        if self.verbose:
+            ranking = ", ".join(
+                f"{c.__name__}={t * 1e6:.0f}us"
+                for c, t in zip(candidates, times)
+            )
+            print(f"autotuner: {ranking} -> {candidates[best].__name__}")
+        self.cache[key] = candidates[best]
+        return candidates[best]
+
+    # reference API name (runtime_tuner.py:16)
+    choose_function = choose
+
+    def final_tune(self) -> None:
+        """Freeze: no further timing; cached winners stay (reference :31-32)."""
+        self.frozen = True
+
+
+_default_tuner: Optional[RuntimeAutoTuner] = None
+
+
+def get_default_tuner() -> Optional[RuntimeAutoTuner]:
+    return _default_tuner
+
+
+def set_default_tuner(tuner: Optional[RuntimeAutoTuner]) -> None:
+    """Install a process-wide tuner consulted by op dispatch sites when no
+    per-call tuner is passed (the reference threads one through every module
+    constructor; a process-global default is the functional equivalent)."""
+    global _default_tuner
+    _default_tuner = tuner

@@ -1,0 +1,43 @@
+"""Pallas flash attention for TPU.
+
+The reference's "flash_attention" is a thin wrapper over torch's
+F.scaled_dot_product_attention (reference example/model.py:44-51).  The TPU
+equivalent wraps JAX's Pallas TPU flash-attention kernel (blockwise
+softmax(QK^T)V with O(T) memory, fwd + bwd kernels), which keeps the
+attention working set in VMEM and avoids materializing the (T, T) score
+matrix in HBM.
+
+Falls back are handled by the caller (ops/attention.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes,
+    flash_attention as _tpu_flash_attention,
+)
+
+
+def pallas_flash_attention(q, k, v):
+    """Causal flash attention on (B, H, T, Dh) tensors."""
+    t = q.shape[2]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    block = max(128, min(512, t))
+    bs = BlockSizes(
+        block_q=min(block, t),
+        block_k_major=min(block, t),
+        block_k=min(block, t),
+        block_b=1,
+        block_q_major_dkv=min(block, t),
+        block_k_major_dkv=min(block, t),
+        block_k_dkv=min(block, t),
+        block_q_dkv=min(block, t),
+        block_k_major_dq=min(block, t),
+        block_k_dq=min(block, t),
+        block_q_dq=min(block, t),
+    )
+    return _tpu_flash_attention(
+        q, k, v, causal=True, sm_scale=scale, block_sizes=bs
+    )

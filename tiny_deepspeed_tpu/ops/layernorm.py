@@ -28,9 +28,24 @@ import jax
 import jax.numpy as jnp
 
 
+def _fwd_candidates(x):
+    """Dispatch table (reference keeps a 1-element candidate list per site,
+    ops/layernorm.py:12-40; here the Pallas kernel is a real second entry)."""
+    cands = [_ln_fwd_xla]
+    if jax.default_backend() == "tpu":
+        from .layernorm_pallas import ln_fwd_pallas_dispatch, pallas_supported
+        if pallas_supported(x):
+            cands.insert(0, ln_fwd_pallas_dispatch)
+    return cands
+
+
 def layernorm_fwd(x, w, b, eps=1e-5, tuner=None):
     """Returns (y, mean, rstd); mean/rstd are float32 with shape x.shape[:-1]."""
-    impl = tuner.choose(_CANDIDATES_FWD, (x, w, b)) if tuner else _ln_fwd_xla
+    if tuner is None:
+        from ..autotuner import get_default_tuner
+        tuner = get_default_tuner()
+    cands = _fwd_candidates(x)
+    impl = tuner.choose(cands, (x, w, b), eps=eps) if tuner else cands[0]
     return impl(x, w, b, eps)
 
 
@@ -51,6 +66,10 @@ def layernorm_dx(gy, x, w, mean, rstd, tuner=None):
       dxhat = gy * w
       dx    = rstd * (dxhat - mean(dxhat) - xhat * mean(dxhat * xhat))
     """
+    if jax.default_backend() == "tpu":
+        from .layernorm_pallas import ln_dx_pallas, pallas_supported
+        if pallas_supported(x):
+            return ln_dx_pallas(gy, x, w, mean, rstd)
     n = x.shape[-1]
     xf = x.astype(jnp.float32)
     gyf = gy.astype(jnp.float32)
@@ -64,6 +83,10 @@ def layernorm_dx(gy, x, w, mean, rstd, tuner=None):
 
 def layernorm_dwdb(gy, x, mean, rstd, tuner=None):
     """(dw, db) reduced over all leading dims (reference ops/layernorm.py:272-298)."""
+    if jax.default_backend() == "tpu":
+        from .layernorm_pallas import ln_dwdb_pallas, pallas_supported
+        if pallas_supported(x):
+            return ln_dwdb_pallas(gy, x, mean, rstd)
     xf = x.astype(jnp.float32)
     gyf = gy.astype(jnp.float32)
     xhat = (xf - mean[..., None]) * rstd[..., None]
