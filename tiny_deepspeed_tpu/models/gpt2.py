@@ -40,10 +40,9 @@ from ..ops import (
     linear,
     layernorm,
     embedding,
-    standard_attention,
-    flash_attention,
     softmax_cross_entropy,
 )
+from ..ops.attention import sharded_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,7 +136,7 @@ class GPT2Model:
 
     # -- forward -----------------------------------------------------------
 
-    def _block(self, x, bp):
+    def _block(self, x, bp, pctx=None):
         """One pre-LN transformer block. x: (B, T, D) in compute_dtype;
         bp: dict of this block's params (leading layer axis already sliced)."""
         c = self.config
@@ -151,11 +150,9 @@ class GPT2Model:
         def heads(z):  # (B, T, D) -> (B, H, T, Dh)
             return z.reshape(b, t, c.n_head, c.head_dim).swapaxes(1, 2)
 
-        attn = (
-            flash_attention if c.attn_impl == "flash_attention"
-            else standard_attention
+        y = sharded_attention(
+            heads(q), heads(k), heads(v), c.attn_impl, pctx
         )
-        y = attn(heads(q), heads(k), heads(v))
         y = y.swapaxes(1, 2).reshape(b, t, d)
         y = linear(y, bp["attn.proj.w"].astype(cd), bp["attn.proj.b"].astype(cd))
         x = x + y
@@ -166,9 +163,14 @@ class GPT2Model:
         h = linear(h, bp["mlp.proj.w"].astype(cd), bp["mlp.proj.b"].astype(cd))
         return x + h
 
-    def apply(self, params, idx, targets: Optional[jax.Array] = None):
+    def apply(self, params, idx, targets: Optional[jax.Array] = None,
+              pctx=None):
         """Forward pass.  Returns mean loss if targets given, else logits —
-        same contract as reference GPT2Model.forward (model.py:139-157)."""
+        same contract as reference GPT2Model.forward (model.py:139-157).
+
+        `pctx` (ParallelContext) makes the forward mesh-aware: activations
+        shard (batch over "data", tokens over "seq" when sequence-parallel)
+        and attention dispatches to the sharded kernels."""
         c = self.config
         cd = c.compute_dtype
         b, t = idx.shape
@@ -181,11 +183,21 @@ class GPT2Model:
         pos = params["wpe"][:t].astype(cd)
         x = tok + pos[None]
 
+        if pctx is not None and pctx.is_multi_device:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(
+                    pctx.mesh, P(pctx.data_axis, pctx.seq_axis, None)
+                ),
+            )
+
         stacked = {
             k[len("h."):]: v for k, v in params.items() if k.startswith("h.")
         }
 
-        block = self._block
+        def block(x, bp):
+            return self._block(x, bp, pctx)
+
         if c.remat:
             block = jax.checkpoint(block)
 
@@ -203,5 +215,5 @@ class GPT2Model:
         logits = linear(x[:, -1:], params["lm_head.w"].astype(cd), None)
         return logits.astype(jnp.float32)
 
-    def __call__(self, params, idx, targets=None):
-        return self.apply(params, idx, targets)
+    def __call__(self, params, idx, targets=None, pctx=None):
+        return self.apply(params, idx, targets, pctx)

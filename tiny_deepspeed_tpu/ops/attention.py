@@ -56,3 +56,40 @@ def flash_attention(q, k, v):
         ).swapaxes(1, 2)
     except Exception:
         return standard_attention(q, k, v)
+
+
+def sharded_attention(q, k, v, impl: str, pctx=None):
+    """Mesh-aware attention dispatch on (B, H, T, Dh) tensors.
+
+    * no mesh / 1 device       -> plain `flash_attention`/`standard_attention`
+    * sequence-parallel mesh   -> ring attention over the "seq" axis
+      (ppermute ring, O(T/n) memory — the long-context path the reference
+      lacks entirely, SURVEY §5.7)
+    * data-parallel mesh + TPU -> the Pallas flash kernel per batch shard
+      under shard_map (XLA cannot auto-partition a custom call; without this
+      the kernel would force an all-gather of the batch)
+    * otherwise                -> jnp path, GSPMD partitions the einsums
+    """
+    if pctx is None or not pctx.is_multi_device:
+        return (flash_attention if impl == "flash_attention"
+                else standard_attention)(q, k, v)
+
+    from ..parallel.ring_attention import ring_attention
+    from jax.sharding import PartitionSpec as P
+
+    if pctx.seq_parallel:
+        return ring_attention(
+            q, k, v, pctx.mesh, seq_axis=pctx.seq_axis,
+            batch_axis=pctx.data_axis,
+        )
+
+    if impl == "flash_attention" and jax.default_backend() == "tpu":
+        from .attention_pallas import pallas_flash_attention
+        spec = P(pctx.data_axis, None, None, None)
+        return jax.shard_map(
+            pallas_flash_attention, mesh=pctx.mesh,
+            in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+        )(q, k, v)
+
+    return (flash_attention if impl == "flash_attention"
+            else standard_attention)(q, k, v)

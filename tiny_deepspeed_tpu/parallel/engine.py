@@ -55,7 +55,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import make_mesh
+from .mesh import make_mesh, ParallelContext
 from .partition import partition_tensors
 
 try:
@@ -153,26 +153,48 @@ class ZeroEngine:
         accum_steps: int = 1,
         evenness_priority: float = 0.0,
         donate: bool = True,
+        seq_parallel: int = 1,
     ):
+        """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
+        tokens shard over it and attention runs as a ppermute ring
+        (context parallelism — absent from the reference, SURVEY §5.7)."""
         self.model = model
         self.optimizer = optimizer
         if mesh is None:
-            mesh = (
-                make_mesh()
-                if self.data_parallel
-                else make_mesh(devices=[jax.devices()[0]])
-            )
+            if not self.data_parallel:
+                mesh = make_mesh(devices=[jax.devices()[0]])
+            elif seq_parallel > 1:
+                n = len(jax.devices())
+                if n % seq_parallel:
+                    raise ValueError(
+                        f"seq_parallel={seq_parallel} must divide "
+                        f"device count {n}"
+                    )
+                mesh = make_mesh(
+                    (n // seq_parallel, seq_parallel), ("data", "seq")
+                )
+            else:
+                mesh = make_mesh()
         self.mesh = mesh
+        self.seq_axis = (
+            "seq" if "seq" in mesh.axis_names and mesh.shape.get("seq", 1) > 1
+            else None
+        )
+        self.pctx = ParallelContext(
+            mesh=mesh, data_axis="data", seq_axis=self.seq_axis
+        )
         self.accum_steps = int(accum_steps)
         self.n_dev = mesh.devices.size
+        # ZeRO sharding happens over the data axis only
+        self.n_shard = mesh.shape["data"]
 
         shapes = model.param_shapes()
         # API-parity ownership table (the reference's cache rank map).
         self.rank_map = partition_tensors(
-            shapes, self.n_dev, evenness_priority
+            shapes, self.n_shard, evenness_priority
         )
 
-        specs = _param_spec_tree(shapes, self.n_dev)
+        specs = _param_spec_tree(shapes, self.n_shard)
         self._shard_spec = specs  # even-shard spec per param
         self._shard_shardings = _to_shardings(specs, mesh)
         rep = {n: P() for n in specs}
@@ -184,7 +206,10 @@ class ZeroEngine:
         opt_specs = _opt_spec_tree(opt_shapes, specs, sharded=self.stage >= 1)
         self._opt_shardings = _to_shardings(opt_specs, mesh)
 
-        batch_spec = P("data") if self.data_parallel else P()
+        if self.data_parallel:
+            batch_spec = P("data", self.seq_axis)  # (B, T): tokens shard too
+        else:
+            batch_spec = P()
         if self.accum_steps > 1:
             batch_spec = P(None, *batch_spec)
         self._batch_sharding = NamedSharding(mesh, batch_spec)
@@ -235,7 +260,7 @@ class ZeroEngine:
         params = state.params
 
         def loss_fn(p, ix, tg):
-            return self.model.apply(p, ix, tg)
+            return self.model.apply(p, ix, tg, pctx=self.pctx)
 
         if self.accum_steps == 1:
             loss, grads = jax.value_and_grad(loss_fn)(params, idx, targets)

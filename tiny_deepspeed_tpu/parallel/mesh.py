@@ -72,6 +72,34 @@ def make_mesh(
     return Mesh(devices.reshape(shape), axis_names)
 
 
+import dataclasses
+from typing import Optional as _Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelContext:
+    """How a model forward should lay activations on the mesh.
+
+    The reference has no equivalent — its modes only vary backward-hook
+    collectives.  Here the context carries the mesh and axis names so the
+    model can (a) run Pallas kernels per-shard under shard_map (XLA cannot
+    auto-partition custom calls) and (b) shard the sequence axis for
+    ring-attention context parallelism.
+    """
+
+    mesh: Mesh
+    data_axis: str = "data"
+    seq_axis: _Optional[str] = None
+
+    @property
+    def is_multi_device(self) -> bool:
+        return self.mesh is not None and self.mesh.devices.size > 1
+
+    @property
+    def seq_parallel(self) -> bool:
+        return self.seq_axis is not None and self.mesh.shape[self.seq_axis] > 1
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -1,0 +1,86 @@
+"""Ring attention: causal attention over a sequence-sharded mesh axis.
+
+ABSENT from the reference (SURVEY §2.20, §5.7: max context = block_size 1024,
+no sequence/context parallelism of any kind) but first-class here: long
+sequences shard over a "seq" mesh axis; each device holds a (B, H, T/n, Dh)
+shard of Q/K/V and K/V blocks rotate around the ring via `ppermute` while
+each device accumulates its queries' attention with an online (flash-style)
+running max/sum softmax.  Communication rides ICI neighbor links — the
+all-gather of full K/V never materializes, so attention memory stays O(T/n)
+per device and context length scales linearly with the ring size.
+
+Causality at block granularity: K/V blocks strictly *ahead* of the local
+query block contribute nothing (masked), the diagonal block is lower-
+triangular, blocks behind are unmasked.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30  # finite -inf stand-in: avoids NaN from (-inf) - (-inf)
+
+
+def ring_attention_local(q, k, v, *, axis_name: str, axis_size: int):
+    """Per-shard body (call inside shard_map over `axis_name`).
+
+    q, k, v: (B, H, Tl, Dh) local sequence shards.  Returns (B, H, Tl, Dh).
+    """
+    b, h, tl, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    my = jax.lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32)
+    q_pos = my * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+
+    o0 = jnp.zeros((b, h, tl, d), jnp.float32)
+    l0 = jnp.zeros((b, h, tl, 1), jnp.float32)
+    m0 = jnp.full((b, h, tl, 1), _NEG, jnp.float32)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(carry, i):
+        o, l, m, kc, vc = carry
+        src = (my - i) % axis_size  # global block id of kc/vc
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = src * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
+        mask = q_pos >= k_pos  # (tl, tl) causal at global positions
+        s = jnp.where(mask, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o, l, m_new, kc, vc), None
+
+    (o, l, _, _, _), _ = jax.lax.scan(
+        step, (o0, l0, m0, k, v), jnp.arange(axis_size)
+    )
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                   batch_axis=None):
+    """shard_map entry: q/k/v (B, H, T, Dh) with T sharded over `seq_axis`
+    (and optionally B over `batch_axis`)."""
+    n = mesh.shape[seq_axis]
+    spec = P(batch_axis, None, seq_axis, None)
+    fn = functools.partial(
+        ring_attention_local, axis_name=seq_axis, axis_size=n
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

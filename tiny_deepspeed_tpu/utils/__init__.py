@@ -1,0 +1,5 @@
+"""Utilities: checkpointing, profiling."""
+
+from .checkpoint import save_checkpoint, load_checkpoint, latest_step
+
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_step"]
