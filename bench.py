@@ -63,6 +63,21 @@ def main():
     step_time, state = measure(engine, state, (idx, tgt))
     tokens_per_sec_chip = b * t / step_time / n_chips
 
+    # peak HBM/chip: live state + XLA temp from the compiled step
+    # (device.memory_stats is unavailable through the axon tunnel)
+    hbm_gb = None
+    try:
+        lowered = engine._step.lower(state, (idx, tgt))
+        mem = lowered.compile().memory_analysis()
+        state_bytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(state)
+        )
+        hbm_gb = round(
+            (state_bytes + mem.temp_size_in_bytes) / n_chips / 2**30, 3
+        )
+    except Exception:
+        pass
+
     # model FLOPs estimate (6 * params * tokens per fwd+bwd) for MFU context
     n_params = model.num_params()
     flops_per_step = 6 * n_params * b * t
@@ -92,6 +107,7 @@ def main():
             "seq_len": t,
             "step_time_s": round(step_time, 4),
             "approx_mfu": round(mfu, 3),
+            "peak_hbm_gb_per_chip": hbm_gb,
         },
     }))
 
