@@ -46,6 +46,11 @@ def parse_args(default_model="gpt2-124m"):
     p.add_argument("--lr", type=float, default=1e-5)
     p.add_argument("--weight-decay", type=float, default=0.1)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--data", default=None, metavar="TOKENS.bin",
+        help="binary uint16 token corpus (nanoGPT .bin convention); "
+             "default: synthetic random tokens, the reference demo workload",
+    )
     return p.parse_args()
 
 
@@ -75,15 +80,20 @@ def run(engine_cls, args, single_device=False):
     b = args.batch_per_device * n_dev
     vocab = model.config.vocab_size
 
-    data_key = jax.random.PRNGKey(args.seed + 1)
+    # Native prefetching pipeline (C++ producer threads): batches are ready
+    # before the device asks — the reference rebuilds tensors on the host
+    # inside the loop (example/ddp/train.py:23-24).
+    from tiny_deepspeed_tpu.data import TokenLoader
+    loader = TokenLoader(args.data, batch=b, seq=args.seq_len,
+                         vocab_size=vocab, seed=args.seed)
+
     t0 = time.perf_counter()
     for it in range(args.iters):
-        data_key, k1, k2 = jax.random.split(data_key, 3)
-        idx = jax.random.randint(k1, (b, args.seq_len), 0, vocab, jnp.int32)
-        tgt = jax.random.randint(k2, (b, args.seq_len), 0, vocab, jnp.int32)
-        state, loss = engine.step(state, (idx, tgt))
+        idx, tgt = loader.next()
+        state, loss = engine.step(state, (jnp.asarray(idx), jnp.asarray(tgt)))
         if jax.process_index() == 0:
             print(f"iter {it:3d} loss {float(loss):.4f}")
+    loader.close()
     dt = time.perf_counter() - t0
     if jax.process_index() == 0:
         toks = args.iters * b * args.seq_len

@@ -1,0 +1,64 @@
+"""Profiling/metrics subsystem: timer, comm report, JSONL metrics."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+from tiny_deepspeed_tpu import AdamW, DDP, GPTConfig, GPT2Model, Zero2, Zero3
+from tiny_deepspeed_tpu.utils import (
+    MetricsLogger, StepTimer, comm_report, device_sync,
+)
+
+TINY = GPTConfig(
+    block_size=32, vocab_size=128, n_layer=2, n_head=2, n_embd=32,
+    compute_dtype=jnp.float32,
+)
+
+
+class TestStepTimer:
+    def test_times_steps(self):
+        model = GPT2Model(TINY)
+        eng = DDP(model, AdamW(lr=1e-3))
+        state = eng.init(jax.random.PRNGKey(0))
+        idx = jnp.zeros((8, 32), jnp.int32)
+        timer = StepTimer()
+        for _ in range(3):
+            with timer.step():
+                state, loss = eng.step(state, (idx, idx))
+                timer.observe(loss)
+        assert len(timer.times) == 3
+        assert timer.mean_s > 0
+
+    def test_device_sync_returns_value(self):
+        assert device_sync(jnp.full((4,), 7.0)) == 7.0
+
+
+class TestCommReport:
+    def test_stage_shapes(self):
+        model = GPT2Model(TINY)
+        rep0 = comm_report(DDP(model, AdamW(lr=1e-3)))
+        rep2 = comm_report(Zero2(model, AdamW(lr=1e-3)))
+        rep3 = comm_report(Zero3(model, AdamW(lr=1e-3)))
+        assert rep0["grad_allreduce_bytes"] > 0
+        assert rep0["grad_reduce_scatter_bytes"] == 0
+        assert rep2["grad_reduce_scatter_bytes"] > 0
+        assert rep2["param_all_gather_bytes"] > 0
+        assert rep3["zero3_layer_gather_bytes"] > 0
+        assert rep3["param_all_gather_bytes"] == 0
+        # DDP all-reduce is the "2g" of the reference comment ledger
+        assert rep0["grad_allreduce_bytes"] == 2 * rep2["grad_reduce_scatter_bytes"]
+
+
+class TestMetricsLogger:
+    def test_jsonl_output(self, tmp_path, capsys):
+        path = tmp_path / "metrics.jsonl"
+        logger = MetricsLogger(str(path), stdout=True)
+        logger.log(0, loss=1.25, tokens_per_sec=1000.0)
+        logger.log(1, loss=1.20, tokens_per_sec=1100.0)
+        logger.close()
+        lines = [json.loads(x) for x in path.read_text().splitlines()]
+        assert [x["step"] for x in lines] == [0, 1]
+        assert lines[0]["loss"] == 1.25
+        out = capsys.readouterr().out
+        assert "step     0" in out and "loss 1.2500" in out

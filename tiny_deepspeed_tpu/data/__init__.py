@@ -1,0 +1,5 @@
+"""Data pipeline: native prefetching loader + NumPy fallback."""
+
+from .loader import TokenLoader, native_available
+
+__all__ = ["TokenLoader", "native_available"]

@@ -1,0 +1,164 @@
+"""TokenLoader: (B, T) next-token batches, produced off the critical path.
+
+Python binding (ctypes — no pybind11 in this image) over the native C++
+pipeline in native/dataloader.cpp; compiled on first use with g++ and cached
+next to the source.  Falls back to a NumPy implementation with identical
+semantics when no compiler is available.
+
+Two modes, both deterministic per seed:
+  * corpus mode: `TokenLoader("tokens.bin", ...)` — random crops of a
+    memory-mapped uint16 (or `.u32`) token file, targets pre-shifted;
+  * synthetic mode: `TokenLoader(None, vocab_size=...)` — uniform random
+    tokens, the reference demo workload (example/ddp/train.py:23-24) without
+    per-step host tensor construction.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "dataloader.cpp"))
+_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "libtds_dataloader.so"))
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _load_native():
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-pthread", _SRC, "-o", _SO],
+                    check=True, capture_output=True, text=True,
+                )
+            lib = ctypes.CDLL(_SO)
+            lib.tds_loader_create.restype = ctypes.c_void_p
+            lib.tds_loader_create.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.tds_loader_next.restype = ctypes.c_int
+            lib.tds_loader_next.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.tds_loader_tokens.restype = ctypes.c_longlong
+            lib.tds_loader_tokens.argtypes = [ctypes.c_void_p]
+            lib.tds_loader_destroy.restype = None
+            lib.tds_loader_destroy.argtypes = [ctypes.c_void_p]
+            lib.tds_loader_error.restype = ctypes.c_char_p
+            _lib = lib
+        except Exception as e:  # no compiler / build failure -> fallback
+            _build_error = str(e)
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+class TokenLoader:
+    """Iterator of (x, y) int32 arrays of shape (batch, seq)."""
+
+    def __init__(self, path: Optional[str], batch: int, seq: int,
+                 vocab_size: int = 50304, seed: int = 0,
+                 prefetch: int = 4, threads: int = 2,
+                 force_numpy: bool = False):
+        self.batch, self.seq, self.vocab = batch, seq, vocab_size
+        self.seed = seed
+        self._handle = None
+        self._lib = None if force_numpy else _load_native()
+        self.backend = "numpy"
+
+        if self._lib is not None:
+            handle = self._lib.tds_loader_create(
+                path.encode() if path else None, vocab_size, batch, seq,
+                seed, prefetch, threads,
+            )
+            if handle:
+                self._handle = ctypes.c_void_p(handle)
+                self.backend = "native"
+            else:
+                err = self._lib.tds_loader_error().decode()
+                if path:  # corpus problems should not be silently eaten
+                    raise FileNotFoundError(err or f"cannot load {path}")
+
+        if self._handle is None:  # NumPy fallback, same semantics
+            self._rng_counter = 0
+            if path:
+                width = np.uint32 if path.endswith(".u32") else np.uint16
+                self._tokens = np.memmap(path, dtype=width, mode="r")
+                if self._tokens.size < seq + 2:
+                    raise FileNotFoundError("corpus smaller than one sequence")
+            else:
+                self._tokens = None
+
+    # -- iteration ---------------------------------------------------------
+
+    def next(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._handle is not None:
+            x = np.empty((self.batch, self.seq), np.int32)
+            y = np.empty((self.batch, self.seq), np.int32)
+            rc = self._lib.tds_loader_next(
+                self._handle,
+                x.ctypes.data_as(ctypes.c_void_p),
+                y.ctypes.data_as(ctypes.c_void_p),
+            )
+            if rc != 0:
+                raise RuntimeError("loader stopped")
+            return x, y
+        return self._numpy_next()
+
+    def _numpy_next(self):
+        rng = np.random.default_rng((self.seed, self._rng_counter))
+        self._rng_counter += 1
+        if self._tokens is not None:
+            usable = self._tokens.size - self.seq - 1
+            starts = rng.integers(0, usable, size=self.batch)
+            x = np.stack([
+                self._tokens[s:s + self.seq] for s in starts
+            ]).astype(np.int32)
+            y = np.stack([
+                self._tokens[s + 1:s + self.seq + 1] for s in starts
+            ]).astype(np.int32)
+            return x, y
+        seqs = rng.integers(
+            0, self.vocab, size=(self.batch, self.seq + 1), dtype=np.int32
+        )
+        return seqs[:, :-1], seqs[:, 1:]
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    @property
+    def n_tokens(self) -> Optional[int]:
+        if self._handle is not None:
+            return int(self._lib.tds_loader_tokens(self._handle))
+        return None if self._tokens is None else int(self._tokens.size)
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.tds_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,245 @@
+// tds_dataloader: native prefetching token-batch pipeline.
+//
+// The reference has NO native components (SURVEY 2.9: 100% Python; its
+// examples build batches with torch.randint on the host each iteration,
+// reference example/ddp/train.py:23-24).  This is the TPU framework's
+// native runtime piece: a C++ producer that keeps (B, T) next-token batches
+// ready ahead of the device, so host batch assembly never sits on the step
+// critical path.
+//
+//   * memory-maps a binary token corpus (uint16 or uint32 little-endian,
+//     nanoGPT .bin convention) and samples random crops, or synthesizes
+//     uniform random tokens when no file is given (the reference's
+//     torch.randint workload);
+//   * N producer threads fill a bounded ring of prepared batches
+//     (x = tokens[i : i+T], y = tokens[i+1 : i+T+1] already shifted);
+//   * consumers copy a ready slot into caller memory (the JAX host buffer)
+//     and release it;
+//   * deterministic per-slot xorshift64* streams seeded from (seed, slot).
+//
+// C ABI (ctypes-friendly), no dependencies beyond pthread:
+//   tds_loader*  tds_loader_create(path_or_null, vocab, batch, seq,
+//                                  seed, prefetch_slots, n_threads)
+//   int          tds_loader_next(loader, int32* x, int32* y)   // blocks
+//   long long    tds_loader_tokens(loader)     // corpus size in tokens
+//   void         tds_loader_destroy(loader)
+//   const char*  tds_loader_error()            // last create error
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+thread_local std::string g_error;
+
+struct Rng {  // xorshift64* — deterministic, cheap, good enough for crops
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ULL) {}
+  uint64_t next() {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return s * 0x2545F4914F6CDD1DULL;
+  }
+  uint64_t below(uint64_t n) { return n ? next() % n : 0; }
+};
+
+struct Batch {
+  std::vector<int32_t> x, y;
+  // slot lifecycle: FREE -> FILLING (a worker owns it) -> READY -> FREE
+  enum State { FREE = 0, FILLING = 1, READY = 2 };
+  std::atomic<int> state{FREE};
+};
+
+struct Loader {
+  // corpus (nullptr => synthetic mode)
+  const uint8_t* map = nullptr;
+  size_t map_bytes = 0;
+  int token_width = 2;  // bytes per token in the file
+  long long n_tokens = 0;
+  int fd = -1;
+
+  int vocab = 50304;
+  int batch = 1, seq = 1024;
+  uint64_t seed = 0;
+
+  std::vector<std::unique_ptr<Batch>> ring;
+  size_t head = 0;  // next slot the consumer takes
+  std::atomic<uint64_t> produced{0};
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::vector<std::thread> workers;
+  std::atomic<uint64_t> next_job{0};
+
+  int32_t token_at(long long i) const {
+    if (token_width == 2) {
+      uint16_t v;
+      std::memcpy(&v, map + i * 2, 2);
+      return static_cast<int32_t>(v);
+    }
+    uint32_t v;
+    std::memcpy(&v, map + i * 4, 4);
+    return static_cast<int32_t>(v);
+  }
+
+  void fill(Batch& b, uint64_t job_id) {
+    Rng rng(seed * 0x100000001b3ULL + job_id + 1);
+    const long long usable = n_tokens - seq - 1;
+    for (int r = 0; r < batch; ++r) {
+      if (map && usable > 0) {
+        long long start = static_cast<long long>(rng.below(usable));
+        for (int t = 0; t < seq; ++t) {
+          b.x[r * seq + t] = token_at(start + t);
+          b.y[r * seq + t] = token_at(start + t + 1);
+        }
+      } else {  // synthetic: uniform tokens, targets shifted like a corpus
+        int32_t prev = static_cast<int32_t>(rng.below(vocab));
+        for (int t = 0; t < seq; ++t) {
+          int32_t nxt = static_cast<int32_t>(rng.below(vocab));
+          b.x[r * seq + t] = prev;
+          b.y[r * seq + t] = nxt;
+          prev = nxt;
+        }
+      }
+    }
+  }
+
+  void worker() {
+    for (;;) {
+      uint64_t job;
+      size_t slot;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] {
+          if (stop.load()) return true;
+          uint64_t j = next_job.load();
+          return ring[j % ring.size()]->state.load() == Batch::FREE;
+        });
+        if (stop.load()) return;
+        job = next_job.fetch_add(1);
+        slot = job % ring.size();
+        ring[slot]->state.store(Batch::FILLING);
+      }
+      fill(*ring[slot], job);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ring[slot]->state.store(Batch::READY);
+      }
+      cv_ready.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* tds_loader_error() { return g_error.c_str(); }
+
+void* tds_loader_create(const char* path, int vocab, int batch, int seq,
+                        uint64_t seed, int prefetch_slots, int n_threads) {
+  auto* L = new Loader();
+  L->vocab = vocab;
+  L->batch = batch;
+  L->seq = seq;
+  L->seed = seed;
+
+  if (path && path[0]) {
+    L->fd = ::open(path, O_RDONLY);
+    if (L->fd < 0) {
+      g_error = std::string("cannot open ") + path;
+      delete L;
+      return nullptr;
+    }
+    struct stat st;
+    ::fstat(L->fd, &st);
+    L->map_bytes = static_cast<size_t>(st.st_size);
+    // token width: assume uint16 unless the size suggests uint32 via suffix
+    const char* dot = std::strrchr(path, '.');
+    L->token_width = (dot && std::strcmp(dot, ".u32") == 0) ? 4 : 2;
+    L->n_tokens = static_cast<long long>(L->map_bytes / L->token_width);
+    if (L->n_tokens < seq + 2) {
+      g_error = "corpus smaller than one sequence";
+      ::close(L->fd);
+      delete L;
+      return nullptr;
+    }
+    L->map = static_cast<const uint8_t*>(
+        ::mmap(nullptr, L->map_bytes, PROT_READ, MAP_PRIVATE, L->fd, 0));
+    if (L->map == MAP_FAILED) {
+      g_error = "mmap failed";
+      ::close(L->fd);
+      delete L;
+      return nullptr;
+    }
+    ::madvise(const_cast<uint8_t*>(L->map), L->map_bytes, MADV_RANDOM);
+  }
+
+  int slots = prefetch_slots > 1 ? prefetch_slots : 2;
+  for (int i = 0; i < slots; ++i) {
+    auto b = std::make_unique<Batch>();
+    b->x.resize(static_cast<size_t>(batch) * seq);
+    b->y.resize(static_cast<size_t>(batch) * seq);
+    L->ring.push_back(std::move(b));
+  }
+  int threads = n_threads > 0 ? n_threads : 1;
+  if (threads > slots) threads = slots;
+  for (int i = 0; i < threads; ++i)
+    L->workers.emplace_back([L] { L->worker(); });
+  return L;
+}
+
+int tds_loader_next(void* handle, int32_t* out_x, int32_t* out_y) {
+  auto* L = static_cast<Loader*>(handle);
+  size_t slot = L->head % L->ring.size();
+  Batch& b = *L->ring[slot];
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_ready.wait(lk, [&] {
+      return b.state.load() == Batch::READY || L->stop.load();
+    });
+    if (L->stop.load()) return -1;
+  }
+  std::memcpy(out_x, b.x.data(), b.x.size() * sizeof(int32_t));
+  std::memcpy(out_y, b.y.data(), b.y.size() * sizeof(int32_t));
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    b.state.store(Batch::FREE);
+    L->head += 1;
+  }
+  L->cv_free.notify_all();
+  return 0;
+}
+
+long long tds_loader_tokens(void* handle) {
+  return static_cast<Loader*>(handle)->n_tokens;
+}
+
+void tds_loader_destroy(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop.store(true);
+  }
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->workers) t.join();
+  if (L->map) ::munmap(const_cast<uint8_t*>(L->map), L->map_bytes);
+  if (L->fd >= 0) ::close(L->fd);
+  delete L;
+}
+
+}  // extern "C"

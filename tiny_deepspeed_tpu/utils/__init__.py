@@ -1,5 +1,17 @@
-"""Utilities: checkpointing, profiling."""
+"""Utilities: checkpointing, profiling, metrics."""
 
 from .checkpoint import save_checkpoint, load_checkpoint, latest_step
+from .profiling import (
+    trace, StepTimer, comm_report, MetricsLogger, device_sync,
+)
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_step"]
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "latest_step",
+    "trace",
+    "StepTimer",
+    "comm_report",
+    "MetricsLogger",
+    "device_sync",
+]
