@@ -47,6 +47,14 @@ def parse_args(default_model="gpt2-124m"):
     p.add_argument("--weight-decay", type=float, default=0.1)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
+        "--tensor-parallel", type=int, default=1, metavar="TP",
+        help="Megatron-style intra-layer sharding over a 'model' mesh axis",
+    )
+    p.add_argument(
+        "--seq-parallel", type=int, default=1, metavar="SP",
+        help="ring-attention context parallelism over a 'seq' mesh axis",
+    )
+    p.add_argument(
         "--data", default=None, metavar="TOKENS.bin",
         help="binary uint16 token corpus (nanoGPT .bin convention); "
              "default: synthetic random tokens, the reference demo workload",
@@ -61,16 +69,20 @@ def run(engine_cls, args, single_device=False):
     init_distributed()
     model = GPT2Model(GPT2_PRESETS[args.model])
 
+    opt = AdamW(lr=args.lr, weight_decay=args.weight_decay)
     if single_device:
-        mesh = make_mesh(devices=[jax.devices()[0]])
+        engine = engine_cls(
+            model, opt, mesh=make_mesh(devices=[jax.devices()[0]])
+        )
         n_dev = 1
     else:
-        mesh = make_mesh()
-        n_dev = mesh.devices.size
-
-    engine = engine_cls(
-        model, AdamW(lr=args.lr, weight_decay=args.weight_decay), mesh=mesh
-    )
+        # engine builds the (data[, seq][, model]) mesh from the flags
+        engine = engine_cls(
+            model, opt,
+            seq_parallel=getattr(args, "seq_parallel", 1),
+            tensor_parallel=getattr(args, "tensor_parallel", 1),
+        )
+        n_dev = engine.n_dev
     if jax.process_index() == 0:
         print(engine.describe())
         print(f"model={args.model} params={model.num_params()/1e6:.1f}M "

@@ -1,0 +1,87 @@
+"""Tensor parallelism (Megatron-style "model" mesh axis) on the 8-device CPU
+mesh.  TP is a capability the reference lacks entirely (SURVEY §2.20: the
+parallelism surface is DP + ZeRO only); here it composes with every ZeRO
+stage and with sequence parallelism, and the acceptance criterion is the
+strongest one: bitwise-close loss parity with single-device training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tiny_deepspeed_tpu import (
+    GPTConfig, GPT2Model, AdamW, SingleDevice, DDP, Zero1, Zero3,
+)
+
+TINY = GPTConfig(
+    block_size=32, vocab_size=128, n_layer=2, n_head=4, n_embd=32,
+    compute_dtype=jnp.float32,
+)
+
+
+def make_batch(key, b=8, t=32, vocab=128):
+    k1, k2 = jax.random.split(key)
+    return (jax.random.randint(k1, (b, t), 0, vocab),
+            jax.random.randint(k2, (b, t), 0, vocab))
+
+
+def run_steps(engine, n=3):
+    state = engine.init(jax.random.PRNGKey(0))
+    losses = []
+    for i in range(n):
+        state, loss = engine.step(state, make_batch(jax.random.PRNGKey(100 + i)))
+        losses.append(float(loss))
+    return losses, state
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPT2Model(TINY)
+
+
+@pytest.fixture(scope="module")
+def ref_losses(model):
+    losses, _ = run_steps(SingleDevice(model, AdamW(lr=1e-3)))
+    return losses
+
+
+class TestTensorParallel:
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_ddp_tp_matches_single_device(self, model, ref_losses, tp):
+        got, _ = run_steps(DDP(model, AdamW(lr=1e-3), tensor_parallel=tp))
+        np.testing.assert_allclose(got, ref_losses, rtol=3e-4, atol=3e-4)
+
+    def test_tp_composes_with_seq_parallel(self, model, ref_losses):
+        got, _ = run_steps(
+            DDP(model, AdamW(lr=1e-3), tensor_parallel=2, seq_parallel=2)
+        )
+        np.testing.assert_allclose(got, ref_losses, rtol=3e-4, atol=3e-4)
+
+    @pytest.mark.parametrize("Engine", [Zero1, Zero3])
+    def test_tp_composes_with_zero(self, model, ref_losses, Engine):
+        got, _ = run_steps(Engine(model, AdamW(lr=1e-3), tensor_parallel=2))
+        np.testing.assert_allclose(got, ref_losses, rtol=3e-4, atol=3e-4)
+
+    def test_tp_params_model_sharded(self, model):
+        eng = DDP(model, AdamW(lr=1e-3), tensor_parallel=2)
+        state = eng.init(jax.random.PRNGKey(0))
+        spec = state.params["h.mlp.fc.w"].sharding.spec  # (L, D, 4D)
+        assert "model" in spec
+        # stage 0: no data-axis sharding on params
+        assert "data" not in spec
+
+    def test_zero3_tp_composed_spec(self, model):
+        eng = Zero3(model, AdamW(lr=1e-3), tensor_parallel=2)
+        state = eng.init(jax.random.PRNGKey(0))
+        w = state.params["h.mlp.fc.w"]  # (L, D, 4D)
+        assert "model" in w.sharding.spec and "data" in w.sharding.spec
+        # 4 data shards x 2 model shards cover the tensor 8 ways
+        shard = w.sharding.shard_shape(w.shape)
+        assert np.prod(shard) * 8 == np.prod(w.shape)
+
+    def test_indivisible_tp_raises(self):
+        # n_head=2 not divisible by tp=4 -> qkv output dim check fires
+        cfg = GPTConfig(block_size=32, vocab_size=128, n_layer=2, n_head=2,
+                        n_embd=6, compute_dtype=jnp.float32)
+        with pytest.raises(ValueError):
+            DDP(GPT2Model(cfg), AdamW(lr=1e-3), tensor_parallel=4)

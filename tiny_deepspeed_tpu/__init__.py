@@ -31,7 +31,7 @@ from .parallel.partition import partition_tensors
 from .parallel.engine import SingleDevice, DDP, Zero1, Zero2, Zero3
 from .parallel.mesh import make_mesh, init_distributed
 from .optim import SGD, AdamW
-from .models import GPTConfig, GPT2Model
+from .models import GPTConfig, GPT2Model, MoEConfig, MoEGPT
 
 __version__ = "0.1.0"
 
@@ -48,4 +48,6 @@ __all__ = [
     "AdamW",
     "GPTConfig",
     "GPT2Model",
+    "MoEConfig",
+    "MoEGPT",
 ]

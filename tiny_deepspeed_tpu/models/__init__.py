@@ -1,5 +1,7 @@
-"""Model zoo: GPT-2 family (parity with reference example/model.py)."""
+"""Model zoo: GPT-2 family (parity with reference example/model.py) plus the
+MoE family (expert parallelism — beyond the reference, SURVEY §2.20)."""
 
 from .gpt2 import GPTConfig, GPT2Model, GPT2_PRESETS
+from .moe import MoEConfig, MoEGPT
 
-__all__ = ["GPTConfig", "GPT2Model", "GPT2_PRESETS"]
+__all__ = ["GPTConfig", "GPT2Model", "GPT2_PRESETS", "MoEConfig", "MoEGPT"]

@@ -134,6 +134,23 @@ class GPT2Model:
         }
         return params
 
+    def tp_rules(self) -> Dict[str, int]:
+        """Megatron-style tensor-parallel placement: {param name: dim index
+        to shard over the "model" mesh axis}.  Column-parallel qkv/fc (output
+        dim), row-parallel attn/mlp proj (input dim — GSPMD inserts the psum
+        the row-parallel matmul needs), vocab-parallel lm_head.  Consumed by
+        the engine when tensor_parallel > 1; absent entirely from the
+        reference (SURVEY §2.20: no TP of any kind)."""
+        return {
+            "h.attn.qkv.w": 2,
+            "h.attn.qkv.b": 1,
+            "h.attn.proj.w": 1,
+            "h.mlp.fc.w": 2,
+            "h.mlp.fc.b": 1,
+            "h.mlp.proj.w": 1,
+            "lm_head.w": 1,
+        }
+
     def num_params(self, params=None) -> int:
         shapes = params if params is not None else self.param_shapes()
         return sum(int(math.prod(x.shape)) for x in shapes.values())
@@ -168,14 +185,8 @@ class GPT2Model:
         h = linear(h, bp["mlp.proj.w"], bp["mlp.proj.b"])
         return x + h
 
-    def apply(self, params, idx, targets: Optional[jax.Array] = None,
-              pctx=None):
-        """Forward pass.  Returns mean loss if targets given, else logits —
-        same contract as reference GPT2Model.forward (model.py:139-157).
-
-        `pctx` (ParallelContext) makes the forward mesh-aware: activations
-        shard (batch over "data", tokens over "seq" when sequence-parallel)
-        and attention dispatches to the sharded kernels."""
+    def embed(self, params, idx, pctx=None):
+        """Token + position embedding -> (B, T, D) in compute dtype."""
         c = self.config
         cd = c.compute_dtype
         b, t = idx.shape
@@ -195,34 +206,41 @@ class GPT2Model:
                     pctx.mesh, P(pctx.data_axis, pctx.seq_axis, None)
                 ),
             )
+        return x
 
-        # One mixed-precision cast of the stacked block params per step (the
-        # scan xs), instead of per-layer casts re-reading float32 masters on
-        # every fwd/refwd/bwd pass.  Under ZeRO-3 this also halves the bytes
-        # each per-layer all-gather moves (bf16 shards, not f32).
-        stacked = {
+    def stacked_compute_params(self, params):
+        """The per-block scan xs: "h.*" tensors cast to compute dtype ONCE
+        per step — per-layer casts inside the scan would re-read the float32
+        masters three times per step (fwd, remat re-fwd, bwd).  Under ZeRO-3
+        this also halves the bytes each per-layer all-gather moves."""
+        cd = self.config.compute_dtype
+        return {
             k[len("h."):]: v.astype(cd)
             for k, v in params.items() if k.startswith("h.")
         }
 
+    def remat_policy(self):
+        return {
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+            "dots": jax.checkpoint_policies.dots_saveable,
+            "dots_no_batch":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "all": jax.checkpoint_policies.everything_saveable,
+        }[self.config.remat_policy]
+
+    def block_fn(self, pctx=None):
+        """(x, block_params) -> x, with the configured remat policy applied."""
         def block(x, bp):
             return self._block(x, bp, pctx)
 
-        if c.remat:
-            policies = {
-                "nothing": jax.checkpoint_policies.nothing_saveable,
-                "dots": jax.checkpoint_policies.dots_saveable,
-                "dots_no_batch":
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                "all": jax.checkpoint_policies.everything_saveable,
-            }
-            block = jax.checkpoint(block, policy=policies[c.remat_policy])
+        if self.config.remat:
+            block = jax.checkpoint(block, policy=self.remat_policy())
+        return block
 
-        def scan_body(x, bp):
-            return block(x, bp), None
-
-        x, _ = jax.lax.scan(scan_body, x, stacked)
-
+    def head(self, params, x, targets: Optional[jax.Array] = None):
+        """Final layernorm + lm_head (+ loss when targets given)."""
+        c = self.config
+        cd = c.compute_dtype
         x = layernorm(x, params["ln_f.w"].astype(cd), params["ln_f.b"].astype(cd))
 
         if targets is not None:
@@ -231,6 +249,24 @@ class GPT2Model:
         # inference path: last position only (cheap lm_head)
         logits = linear(x[:, -1:], params["lm_head.w"].astype(cd), None)
         return logits.astype(jnp.float32)
+
+    def apply(self, params, idx, targets: Optional[jax.Array] = None,
+              pctx=None):
+        """Forward pass.  Returns mean loss if targets given, else logits —
+        same contract as reference GPT2Model.forward (model.py:139-157).
+
+        `pctx` (ParallelContext) makes the forward mesh-aware: activations
+        shard (batch over "data", tokens over "seq" when sequence-parallel)
+        and attention dispatches to the sharded kernels."""
+        x = self.embed(params, idx, pctx)
+        stacked = self.stacked_compute_params(params)
+        block = self.block_fn(pctx)
+
+        def scan_body(x, bp):
+            return block(x, bp), None
+
+        x, _ = jax.lax.scan(scan_body, x, stacked)
+        return self.head(params, x, targets)
 
     def __call__(self, params, idx, targets=None, pctx=None):
         return self.apply(params, idx, targets, pctx)

@@ -1,0 +1,229 @@
+"""Mixture-of-Experts GPT: top-k routed experts with expert parallelism.
+
+ABSENT from the reference (SURVEY §2.20: no expert parallelism of any kind —
+its parallelism surface is DP + ZeRO-1/2/3 only); first-class here because
+the build targets the full tp/pp/dp/sp/ep sharding surface.
+
+TPU-first design:
+  * Every block's MLP is replaced by a router + E experts; blocks stay
+    UNIFORM so the stacked-layer `lax.scan` (O(1) compile depth) is kept —
+    expert tensors just carry an extra (E,) axis after the layer axis.
+  * Routing is GShard-style top-k with a STATIC capacity: dispatch/combine
+    are dense one-hot einsums over (tokens, experts, capacity) — no dynamic
+    shapes, no sorting scatter, so XLA tiles everything onto the MXU.
+  * Expert parallelism = sharding the (E,) axis over an "expert" mesh axis
+    (`ep_rules`); the dispatch einsum's contraction over tokens makes GSPMD
+    emit the all-to-all.  Composes with TP (experts' ff dim over "model")
+    and every ZeRO stage (data axis on a remaining dim).
+  * Load-balancing auxiliary loss (Switch-Transformer form) accumulates
+    through the scan carry and is added to the LM loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import linear, layernorm
+from ..ops.attention import sharded_attention
+from .gpt2 import GPTConfig, GPT2Model
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(GPTConfig):
+    """GPTConfig + routing hyperparameters."""
+
+    n_expert: int = 8
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    ff_mult: int = 4  # expert hidden = ff_mult * n_embd
+
+
+class MoEGPT(GPT2Model):
+    """GPT-2 skeleton with MoE MLPs.  Same functional API as GPT2Model."""
+
+    def __init__(self, config: MoEConfig):
+        super().__init__(config)
+
+    # -- params ------------------------------------------------------------
+
+    def init(self, key) -> Dict[str, jax.Array]:
+        c = self.config
+        d, l, v, t, e = c.n_embd, c.n_layer, c.vocab_size, c.block_size, c.n_expert
+        f = c.ff_mult * d
+        std = 0.02
+        pstd = std / math.sqrt(2 * l)
+        keys = iter(jax.random.split(key, 16))
+
+        def nrm(k, shape, s):
+            return (jax.random.normal(k, shape, jnp.float32) * s).astype(
+                c.param_dtype
+            )
+
+        def zeros(shape):
+            return jnp.zeros(shape, c.param_dtype)
+
+        return {
+            "wte": nrm(next(keys), (v, d), std),
+            "wpe": nrm(next(keys), (t, d), std),
+            "h.ln_1.w": jnp.ones((l, d), c.param_dtype),
+            "h.ln_1.b": zeros((l, d)),
+            "h.attn.qkv.w": nrm(next(keys), (l, d, 3 * d), std),
+            "h.attn.qkv.b": zeros((l, 3 * d)),
+            "h.attn.proj.w": nrm(next(keys), (l, d, d), pstd),
+            "h.attn.proj.b": zeros((l, d)),
+            "h.ln_2.w": jnp.ones((l, d), c.param_dtype),
+            "h.ln_2.b": zeros((l, d)),
+            "h.moe.router.w": nrm(next(keys), (l, d, e), std),
+            "h.moe.fc.w": nrm(next(keys), (l, e, d, f), std),
+            "h.moe.fc.b": zeros((l, e, f)),
+            "h.moe.proj.w": nrm(next(keys), (l, e, f, d), pstd),
+            "h.moe.proj.b": zeros((l, e, d)),
+            "ln_f.w": jnp.ones((d,), c.param_dtype),
+            "ln_f.b": zeros((d,)),
+            "lm_head.w": nrm(next(keys), (d, v), std),
+        }
+
+    def tp_rules(self) -> Dict[str, int]:
+        return {
+            "h.attn.qkv.w": 2,
+            "h.attn.qkv.b": 1,
+            "h.attn.proj.w": 1,
+            "h.moe.fc.w": 3,
+            "h.moe.fc.b": 2,
+            "h.moe.proj.w": 2,
+            "lm_head.w": 1,
+        }
+
+    def ep_rules(self) -> Dict[str, int]:
+        """{param: dim of the (E,) experts axis} — sharded over "expert"."""
+        return {
+            "h.moe.fc.w": 1,
+            "h.moe.fc.b": 1,
+            "h.moe.proj.w": 1,
+            "h.moe.proj.b": 1,
+        }
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(self, x, router_w):
+        """Top-k dispatch/combine tensors.  x: (S, D) float32 router input.
+
+        Returns (dispatch (S,E,C) bool-ish, combine (S,E,C), aux scalar).
+        Static capacity C = cf * k * S / E; overflow tokens drop (standard
+        GShard semantics — the residual stream still carries them).
+        """
+        c = self.config
+        s = x.shape[0]
+        e, k = c.n_expert, c.expert_top_k
+        cap = max(1, int(c.capacity_factor * k * s / e))
+
+        logits = jnp.einsum(
+            "sd,de->se", x, router_w, preferred_element_type=jnp.float32
+        )
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)  # (S, k)
+        gate_vals = gate_vals / (
+            jnp.sum(gate_vals, axis=-1, keepdims=True) + 1e-9
+        )
+
+        dispatch = jnp.zeros((s, e, cap), jnp.float32)
+        combine = jnp.zeros((s, e, cap), jnp.float32)
+        counts = jnp.zeros((e,), jnp.float32)  # slots used per expert
+        for j in range(k):  # k is tiny + static: unrolled
+            m = jax.nn.one_hot(expert_idx[:, j], e, dtype=jnp.float32)
+            pos = jnp.cumsum(m, axis=0) - 1 + counts[None]  # (S, E)
+            keep = m * (pos < cap)
+            slot = jax.nn.one_hot(pos.astype(jnp.int32), cap) * keep[..., None]
+            dispatch = dispatch + slot
+            combine = combine + gate_vals[:, j, None, None] * slot
+            counts = counts + jnp.sum(keep, axis=0)
+
+        # Switch-Transformer load-balancing loss: E * <frac_tokens_e * prob_e>
+        frac = jnp.mean(
+            jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32), axis=0
+        )
+        aux = e * jnp.sum(frac * jnp.mean(probs, axis=0))
+        return dispatch, combine, aux
+
+    # -- forward -----------------------------------------------------------
+
+    def _moe_mlp(self, x, bp, pctx=None):
+        """x: (B, T, D) -> (B, T, D), plus aux loss."""
+        c = self.config
+        b, t, d = x.shape
+        xs = x.reshape(b * t, d)
+        dispatch, combine, aux = self._route(
+            xs.astype(jnp.float32), bp["moe.router.w"].astype(jnp.float32)
+        )
+        dispatch = dispatch.astype(x.dtype)
+        # (S,E,C) x (S,D) -> (E,C,D): the all-to-all boundary under EP
+        xe = jnp.einsum("sec,sd->ecd", dispatch, xs)
+        if pctx is not None and pctx.expert_parallel:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            xe = jax.lax.with_sharding_constraint(
+                xe, NamedSharding(pctx.mesh, P(pctx.expert_axis, None, None))
+            )
+        h = jnp.einsum("ecd,edf->ecf", xe, bp["moe.fc.w"]) + bp["moe.fc.b"][:, None]
+        h = jax.nn.gelu(h, approximate=True)
+        ye = jnp.einsum("ecf,efd->ecd", h, bp["moe.proj.w"]) + bp["moe.proj.b"][:, None]
+        y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), ye)
+        return y.reshape(b, t, d), aux
+
+    def _block(self, x, bp, pctx=None):
+        """Pre-LN block: attention + MoE MLP.  Returns (x, aux)."""
+        c = self.config
+        b, t, d = x.shape
+
+        h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
+        qkv = linear(h, bp["attn.qkv.w"], bp["attn.qkv.b"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):
+            return z.reshape(b, t, c.n_head, c.head_dim).swapaxes(1, 2)
+
+        y = sharded_attention(heads(q), heads(k), heads(v), c.attn_impl, pctx)
+        y = y.swapaxes(1, 2).reshape(b, t, d)
+        y = linear(y, bp["attn.proj.w"], bp["attn.proj.b"])
+        x = x + y
+
+        h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
+        y, aux = self._moe_mlp(h, bp, pctx)
+        return x + y, aux
+
+    def stacked_compute_params(self, params):
+        """Like GPT2Model's, but router weights stay float32: routing logits
+        need full precision for a stable softmax/top-k."""
+        cd = self.config.compute_dtype
+        return {
+            k[len("h."):]: (v.astype(cd) if "router" not in k else v)
+            for k, v in params.items() if k.startswith("h.")
+        }
+
+    def apply(self, params, idx, targets: Optional[jax.Array] = None,
+              pctx=None):
+        c = self.config
+        x = self.embed(params, idx, pctx)
+        stacked = self.stacked_compute_params(params)
+
+        def block(carry, bp):
+            x, aux_sum = carry
+            x, aux = self._block(x, bp, pctx)
+            return (x, aux_sum + aux), None
+
+        if c.remat:
+            block = jax.checkpoint(block, policy=self.remat_policy())
+
+        (x, aux_sum), _ = jax.lax.scan(
+            block, (x, jnp.zeros((), jnp.float32)), stacked
+        )
+
+        out = self.head(params, x, targets)
+        if targets is not None:
+            return out + c.aux_loss_weight * aux_sum / c.n_layer
+        return out

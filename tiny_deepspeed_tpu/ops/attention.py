@@ -75,21 +75,32 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
                 else standard_attention)(q, k, v)
 
     from ..parallel.ring_attention import ring_attention
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # tensor parallelism: heads split over the "model" axis; attention is
+    # embarrassingly parallel over heads so every path below just carries
+    # the head axis in its specs.
+    head_axis = pctx.model_axis if pctx.tensor_parallel else None
 
     if pctx.seq_parallel:
         return ring_attention(
             q, k, v, pctx.mesh, seq_axis=pctx.seq_axis,
-            batch_axis=pctx.data_axis,
+            batch_axis=pctx.data_axis, head_axis=head_axis,
         )
 
     if impl == "flash_attention" and jax.default_backend() == "tpu":
         from .attention_pallas import pallas_flash_attention
-        spec = P(pctx.data_axis, None, None, None)
+        spec = P(pctx.data_axis, head_axis, None, None)
         return jax.shard_map(
             pallas_flash_attention, mesh=pctx.mesh,
             in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
         )(q, k, v)
+
+    if head_axis is not None:
+        # pin the head-sharded layout so GSPMD partitions the attention
+        # einsums over heads instead of gathering them
+        sh = NamedSharding(pctx.mesh, P(pctx.data_axis, head_axis, None, None))
+        q, k, v = (jax.lax.with_sharding_constraint(z, sh) for z in (q, k, v))
 
     return (flash_attention if impl == "flash_attention"
             else standard_attention)(q, k, v)

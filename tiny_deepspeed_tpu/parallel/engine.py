@@ -73,48 +73,64 @@ except Exception:  # pragma: no cover - flax always present in this image
 # sharding rules
 # ---------------------------------------------------------------------------
 
-def _leaf_spec(name: str, shape, n_dev: int, axis: str = "data") -> P:
+def _leaf_spec(name: str, shape, n_dev: int, axis: str = "data",
+               reserved: Optional[Dict[int, str]] = None) -> P:
     """Even axis-sharding rule for one tensor.
 
-    Shard the largest axis divisible by the mesh size; tensors from the
-    stacked block ("h.*") never shard the leading (n_layer,) axis — the scan
-    slices it, and keeping it unsharded is what makes XLA's all-gather happen
+    `reserved` pre-places mesh axes on specific dims (tensor/expert
+    parallelism); the ZeRO data-axis shard then goes on the largest
+    *remaining* axis divisible by the mesh size.  Tensors from the stacked
+    block ("h.*") never shard the leading (n_layer,) axis — the scan slices
+    it, and keeping it unsharded is what makes XLA's all-gather happen
     per-layer *inside* the loop (the ZeRO-3 gather-on-demand).  Indivisible /
     small tensors replicate.
     """
     if not shape:
         return P()
-    start = 1 if name.startswith("h.") and len(shape) > 1 else 0
-    best = None
-    for ax in range(start, len(shape)):
-        if shape[ax] % n_dev == 0 and shape[ax] >= n_dev:
-            if best is None or shape[ax] > shape[best]:
-                best = ax
-    if best is None:
-        return P()
     spec = [None] * len(shape)
-    spec[best] = axis
+    for dim, ax in (reserved or {}).items():
+        spec[dim] = ax
+    if n_dev > 1:
+        start = 1 if name.startswith("h.") and len(shape) > 1 else 0
+        best = None
+        for ax in range(start, len(shape)):
+            if spec[ax] is None and shape[ax] % n_dev == 0 and shape[ax] >= n_dev:
+                if best is None or shape[ax] > shape[best]:
+                    best = ax
+        if best is not None:
+            spec[best] = axis
+    while spec and spec[-1] is None:  # P(None, ...) normalizes to P()
+        spec.pop()
     return P(*spec)
 
 
-def _param_spec_tree(shapes: Dict[str, Any], n_dev: int) -> Dict[str, P]:
-    return {n: _leaf_spec(n, s.shape, n_dev) for n, s in shapes.items()}
+def _param_spec_tree(
+    shapes: Dict[str, Any], n_dev: int,
+    reserved: Optional[Dict[str, Dict[int, str]]] = None,
+) -> Dict[str, P]:
+    reserved = reserved or {}
+    return {
+        n: _leaf_spec(n, s.shape, n_dev, reserved=reserved.get(n))
+        for n, s in shapes.items()
+    }
 
 
-def _opt_spec_tree(opt_shapes, param_specs: Dict[str, P], sharded: bool):
+def _opt_spec_tree(opt_shapes, param_specs: Dict[str, P], sharded: bool,
+                   base_specs: Optional[Dict[str, P]] = None):
     """Sharding tree matching the optimizer-state structure.
 
     Per-param slots (m/v/velocity/vmax, shaped like the param) inherit the
-    param's spec when `sharded`; the global step counter replicates.
+    param's full ZeRO spec when `sharded`, else the base (tensor-parallel
+    placement only) spec; the global step counter replicates.
     """
+    table = param_specs if sharded else (base_specs or {})
+
     def spec_for(path, leaf):
-        if not sharded:
-            return P()
         names = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
         # path looks like ('state', '<param name>', 'm')
         for key in names:
-            if key in param_specs and len(param_specs[key]) == len(leaf.shape):
-                return param_specs[key]
+            if key in table and len(table[key]) <= len(leaf.shape):
+                return table[key]
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, opt_shapes)
@@ -154,34 +170,53 @@ class ZeroEngine:
         evenness_priority: float = 0.0,
         donate: bool = True,
         seq_parallel: int = 1,
+        tensor_parallel: int = 1,
+        expert_parallel: int = 1,
     ):
         """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
         tokens shard over it and attention runs as a ppermute ring
-        (context parallelism — absent from the reference, SURVEY §5.7)."""
+        (context parallelism).  tensor_parallel > 1 carves a "model" axis:
+        Megatron-style intra-layer sharding per the model's `tp_rules()`.
+        expert_parallel > 1 carves an "expert" axis: MoE expert sharding per
+        `ep_rules()`.  All compose with every ZeRO stage (the data axis
+        keeps the ZeRO semantics); all are absent from the reference
+        (SURVEY §2.20)."""
         self.model = model
         self.optimizer = optimizer
         if mesh is None:
             if not self.data_parallel:
                 mesh = make_mesh(devices=[jax.devices()[0]])
-            elif seq_parallel > 1:
-                n = len(jax.devices())
-                if n % seq_parallel:
-                    raise ValueError(
-                        f"seq_parallel={seq_parallel} must divide "
-                        f"device count {n}"
-                    )
-                mesh = make_mesh(
-                    (n // seq_parallel, seq_parallel), ("data", "seq")
-                )
             else:
-                mesh = make_mesh()
+                n = len(jax.devices())
+                sp, tp = int(seq_parallel), int(tensor_parallel)
+                ep = int(expert_parallel)
+                if n % (sp * tp * ep):
+                    raise ValueError(
+                        f"seq_parallel={sp} * tensor_parallel={tp} * "
+                        f"expert_parallel={ep} must divide device count {n}"
+                    )
+                shape, names = [n // (sp * tp * ep)], ["data"]
+                if sp > 1:
+                    shape.append(sp); names.append("seq")
+                if tp > 1:
+                    shape.append(tp); names.append("model")
+                if ep > 1:
+                    shape.append(ep); names.append("expert")
+                mesh = make_mesh(tuple(shape), tuple(names))
         self.mesh = mesh
-        self.seq_axis = (
-            "seq" if "seq" in mesh.axis_names and mesh.shape.get("seq", 1) > 1
-            else None
-        )
+
+        def _axis(name):
+            return (
+                name if name in mesh.axis_names
+                and mesh.shape.get(name, 1) > 1 else None
+            )
+
+        self.seq_axis = _axis("seq")
+        self.model_axis = _axis("model")
+        self.expert_axis = _axis("expert")
         self.pctx = ParallelContext(
-            mesh=mesh, data_axis="data", seq_axis=self.seq_axis
+            mesh=mesh, data_axis="data", seq_axis=self.seq_axis,
+            model_axis=self.model_axis, expert_axis=self.expert_axis,
         )
         self.accum_steps = int(accum_steps)
         self.n_dev = mesh.devices.size
@@ -194,16 +229,50 @@ class ZeroEngine:
             shapes, self.n_shard, evenness_priority
         )
 
-        specs = _param_spec_tree(shapes, self.n_shard)
+        # tensor/expert-parallel placements come from the model and are part
+        # of EVERY spec (resting, shard, grad, optimizer) — ZeRO's data-axis
+        # shard composes on a remaining dim.
+        if self.model_axis is not None:
+            # attention shards over heads: validate at init, not deep inside
+            # a shard_map trace at step time (e.g. gpt2-1.5b has n_head=25)
+            nh = getattr(getattr(model, "config", None), "n_head", None)
+            tp_size = mesh.shape[self.model_axis]
+            if nh is not None and nh % tp_size:
+                raise ValueError(
+                    f"n_head={nh} not divisible by tensor-parallel axis "
+                    f"size {tp_size}"
+                )
+
+        reserved: Dict[str, Dict[int, str]] = {}
+        for ax_attr, rules_fn in (
+            (self.model_axis, "tp_rules"), (self.expert_axis, "ep_rules")
+        ):
+            if ax_attr is None:
+                continue
+            size = mesh.shape[ax_attr]
+            for name, dim in getattr(model, rules_fn, dict)().items():
+                if name not in shapes:
+                    continue
+                if shapes[name].shape[dim] % size:
+                    raise ValueError(
+                        f"{name} dim {dim} ({shapes[name].shape[dim]}) not "
+                        f"divisible by {ax_attr} axis size {size}"
+                    )
+                reserved.setdefault(name, {})[dim] = ax_attr
+
+        specs = _param_spec_tree(shapes, self.n_shard, reserved)
         self._shard_spec = specs  # even-shard spec per param
         self._shard_shardings = _to_shardings(specs, mesh)
-        rep = {n: P() for n in specs}
+        # base spec: tensor/expert placements only (no ZeRO data shard)
+        base = _param_spec_tree(shapes, 1, reserved)
         # where params LIVE between steps
-        self._param_spec_rest = specs if self.stage >= 3 else rep
+        self._param_spec_rest = specs if self.stage >= 3 else base
         self._param_shardings = _to_shardings(self._param_spec_rest, mesh)
 
         opt_shapes = jax.eval_shape(optimizer.init, shapes)
-        opt_specs = _opt_spec_tree(opt_shapes, specs, sharded=self.stage >= 1)
+        opt_specs = _opt_spec_tree(
+            opt_shapes, specs, sharded=self.stage >= 1, base_specs=base
+        )
         self._opt_shardings = _to_shardings(opt_specs, mesh)
 
         if self.data_parallel:

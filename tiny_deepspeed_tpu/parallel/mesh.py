@@ -90,6 +90,9 @@ class ParallelContext:
     mesh: Mesh
     data_axis: str = "data"
     seq_axis: _Optional[str] = None
+    model_axis: _Optional[str] = None
+    expert_axis: _Optional[str] = None
+    pipe_axis: _Optional[str] = None
 
     @property
     def is_multi_device(self) -> bool:
@@ -98,6 +101,20 @@ class ParallelContext:
     @property
     def seq_parallel(self) -> bool:
         return self.seq_axis is not None and self.mesh.shape[self.seq_axis] > 1
+
+    @property
+    def tensor_parallel(self) -> bool:
+        return (
+            self.model_axis is not None
+            and self.mesh.shape[self.model_axis] > 1
+        )
+
+    @property
+    def expert_parallel(self) -> bool:
+        return (
+            self.expert_axis is not None
+            and self.mesh.shape[self.expert_axis] > 1
+        )
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

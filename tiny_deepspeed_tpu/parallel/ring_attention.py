@@ -72,11 +72,12 @@ def ring_attention_local(q, k, v, *, axis_name: str, axis_size: int):
 
 
 def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
-                   batch_axis=None):
+                   batch_axis=None, head_axis=None):
     """shard_map entry: q/k/v (B, H, T, Dh) with T sharded over `seq_axis`
-    (and optionally B over `batch_axis`)."""
+    (optionally B over `batch_axis` and H over `head_axis` — heads split
+    across a tensor-parallel axis compose freely with the sequence ring)."""
     n = mesh.shape[seq_axis]
-    spec = P(batch_axis, None, seq_axis, None)
+    spec = P(batch_axis, head_axis, seq_axis, None)
     fn = functools.partial(
         ring_attention_local, axis_name=seq_axis, axis_size=n
     )
