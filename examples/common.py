@@ -30,7 +30,8 @@ from tiny_deepspeed_tpu import (
 from tiny_deepspeed_tpu.models import GPT2_PRESETS
 
 
-def parse_args(default_model="gpt2-124m"):
+def parse_args(default_model="gpt2-124m", **defaults):
+    """`defaults` overrides any flag's default (explicit flags still win)."""
     p = argparse.ArgumentParser()
     p.add_argument(
         "--cpu-devices", type=int, default=0, metavar="N",
@@ -55,10 +56,22 @@ def parse_args(default_model="gpt2-124m"):
         help="ring-attention context parallelism over a 'seq' mesh axis",
     )
     p.add_argument(
+        "--pipeline-parallel", type=int, default=1, metavar="PP",
+        help="GPipe microbatch pipeline over a 'pipe' mesh axis "
+             "(stacked blocks partition into PP stages)",
+    )
+    p.add_argument(
+        "--pipeline-microbatches", type=int, default=0, metavar="M",
+        help="in-flight pipeline microbatches (default PP; raise to "
+             "amortize the (PP-1)/(M+PP-1) bubble)",
+    )
+    p.add_argument(
         "--data", default=None, metavar="TOKENS.bin",
         help="binary uint16 token corpus (nanoGPT .bin convention); "
              "default: synthetic random tokens, the reference demo workload",
     )
+    if defaults:
+        p.set_defaults(**defaults)
     return p.parse_args()
 
 
@@ -81,6 +94,9 @@ def run(engine_cls, args, single_device=False):
             model, opt,
             seq_parallel=getattr(args, "seq_parallel", 1),
             tensor_parallel=getattr(args, "tensor_parallel", 1),
+            pipeline_parallel=getattr(args, "pipeline_parallel", 1),
+            pipeline_microbatches=getattr(args, "pipeline_microbatches", 0)
+            or None,
         )
         n_dev = engine.n_dev
     if jax.process_index() == 0:

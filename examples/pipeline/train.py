@@ -1,0 +1,20 @@
+"""Pipeline parallelism: GPipe microbatch pipeline over a "pipe" mesh axis.
+
+No reference counterpart (the reference's parallelism surface is DP +
+ZeRO-1/2/3 only, SURVEY §2.20).  Composes with ZeRO-1 here; try
+`--pipeline-parallel 2 --tensor-parallel 2 --cpu-devices 8` for a
+dp=2 x tp=2 x pipe=2 mesh without hardware.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from common import parse_args, run  # noqa: E402
+from tiny_deepspeed_tpu import Zero1  # noqa: E402
+
+if __name__ == "__main__":
+    args = parse_args(default_model="gpt2-124m", pipeline_parallel=2)
+    run(Zero1, args)

@@ -86,6 +86,10 @@ class GPT2Model:
     code.
     """
 
+    # apply() implements the GPipe pipeline path (pctx.pipe_parallel);
+    # subclasses that override apply() without it must reset this flag
+    pipeline_capable = True
+
     def __init__(self, config: GPTConfig):
         self.config = config
 
@@ -262,10 +266,22 @@ class GPT2Model:
         stacked = self.stacked_compute_params(params)
         block = self.block_fn(pctx)
 
-        def scan_body(x, bp):
-            return block(x, bp), None
+        if pctx is not None and pctx.pipe_parallel:
+            # GPipe-style SPMD pipeline over the "pipe" axis: each stage owns
+            # n_layer/S stacked layers, microbatches hop stage->stage via
+            # ppermute (parallel/pipeline.py; absent from the reference).
+            from ..parallel.pipeline import spmd_pipeline
+            x = spmd_pipeline(
+                block, stacked, x,
+                mesh=pctx.mesh, pipe_axis=pctx.pipe_axis,
+                data_axis=pctx.data_axis,
+                microbatches=pctx.pipe_microbatches or None,
+            )
+        else:
+            def scan_body(x, bp):
+                return block(x, bp), None
 
-        x, _ = jax.lax.scan(scan_body, x, stacked)
+            x, _ = jax.lax.scan(scan_body, x, stacked)
         return self.head(params, x, targets)
 
     def __call__(self, params, idx, targets=None, pctx=None):

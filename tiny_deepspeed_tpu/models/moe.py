@@ -47,6 +47,10 @@ class MoEConfig(GPTConfig):
 class MoEGPT(GPT2Model):
     """GPT-2 skeleton with MoE MLPs.  Same functional API as GPT2Model."""
 
+    # apply() below carries the aux load-balance loss through a plain scan;
+    # it has no GPipe path, so the engine must reject pipeline_parallel>1
+    pipeline_capable = False
+
     def __init__(self, config: MoEConfig):
         super().__init__(config)
 

@@ -39,6 +39,16 @@ def standard_attention(q, k, v):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def _sdpa_or_standard(q, k, v):
+    """XLA-fused causal SDPA, falling back to the explicit-mask path."""
+    try:
+        return jax.nn.dot_product_attention(
+            q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), is_causal=True
+        ).swapaxes(1, 2)
+    except Exception:
+        return standard_attention(q, k, v)
+
+
 def flash_attention(q, k, v):
     """Blockwise causal attention; Pallas kernel on TPU, fused XLA elsewhere."""
     # Static (trace-time) backend choice: tracers carry no device, and the
@@ -50,12 +60,7 @@ def flash_attention(q, k, v):
             pallas_flash_attention = None
         if pallas_flash_attention is not None:
             return pallas_flash_attention(q, k, v)
-    try:
-        return jax.nn.dot_product_attention(
-            q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2), is_causal=True
-        ).swapaxes(1, 2)
-    except Exception:
-        return standard_attention(q, k, v)
+    return _sdpa_or_standard(q, k, v)
 
 
 def sharded_attention(q, k, v, impl: str, pctx=None):
@@ -87,6 +92,21 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
             q, k, v, pctx.mesh, seq_axis=pctx.seq_axis,
             batch_axis=pctx.data_axis, head_axis=head_axis,
         )
+
+    if pctx.pipe_parallel:
+        # Inside the pipeline's manual-over-"pipe" region a nested full
+        # shard_map (the Pallas flash path below) would re-manualize the
+        # already-manual pipe axis and fail at trace time; use the GSPMD
+        # jnp path, which auto-partitions over the remaining axes.
+        if head_axis is not None:
+            sh = NamedSharding(
+                pctx.mesh, P(pctx.data_axis, head_axis, None, None)
+            )
+            q, k, v = (
+                jax.lax.with_sharding_constraint(z, sh) for z in (q, k, v)
+            )
+        return (_sdpa_or_standard if impl == "flash_attention"
+                else standard_attention)(q, k, v)
 
     if impl == "flash_attention" and jax.default_backend() == "tpu":
         from .attention_pallas import pallas_flash_attention

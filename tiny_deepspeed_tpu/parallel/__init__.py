@@ -10,9 +10,11 @@ collectives chosen by the compiler from NamedSharding constraints.
 from .partition import partition_tensors
 from .mesh import make_mesh, init_distributed
 from .engine import SingleDevice, DDP, Zero1, Zero2, Zero3, TrainState
+from .pipeline import spmd_pipeline
 
 __all__ = [
     "partition_tensors",
+    "spmd_pipeline",
     "make_mesh",
     "init_distributed",
     "SingleDevice",

@@ -172,17 +172,29 @@ class ZeroEngine:
         seq_parallel: int = 1,
         tensor_parallel: int = 1,
         expert_parallel: int = 1,
+        pipeline_parallel: int = 1,
+        pipeline_microbatches: Optional[int] = None,
     ):
         """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
         tokens shard over it and attention runs as a ppermute ring
         (context parallelism).  tensor_parallel > 1 carves a "model" axis:
         Megatron-style intra-layer sharding per the model's `tp_rules()`.
         expert_parallel > 1 carves an "expert" axis: MoE expert sharding per
-        `ep_rules()`.  All compose with every ZeRO stage (the data axis
-        keeps the ZeRO semantics); all are absent from the reference
-        (SURVEY §2.20)."""
+        `ep_rules()`.  pipeline_parallel > 1 carves a "pipe" axis: the
+        stacked transformer blocks partition into S contiguous stages and
+        microbatches flow through a GPipe ppermute pipeline
+        (parallel/pipeline.py; `pipeline_microbatches` defaults to S).
+        All compose with every ZeRO stage (the data axis keeps the ZeRO
+        semantics); all are absent from the reference (SURVEY §2.20)."""
         self.model = model
         self.optimizer = optimizer
+        pp = int(pipeline_parallel)
+        if pp > 1 and int(seq_parallel) > 1:
+            raise ValueError(
+                "pipeline_parallel does not compose with seq_parallel yet "
+                "(ring attention's shard_map cannot nest inside the "
+                "pipeline's manual region)"
+            )
         if mesh is None:
             if not self.data_parallel:
                 mesh = make_mesh(devices=[jax.devices()[0]])
@@ -190,18 +202,21 @@ class ZeroEngine:
                 n = len(jax.devices())
                 sp, tp = int(seq_parallel), int(tensor_parallel)
                 ep = int(expert_parallel)
-                if n % (sp * tp * ep):
+                if n % (sp * tp * ep * pp):
                     raise ValueError(
                         f"seq_parallel={sp} * tensor_parallel={tp} * "
-                        f"expert_parallel={ep} must divide device count {n}"
+                        f"expert_parallel={ep} * pipeline_parallel={pp} "
+                        f"must divide device count {n}"
                     )
-                shape, names = [n // (sp * tp * ep)], ["data"]
+                shape, names = [n // (sp * tp * ep * pp)], ["data"]
                 if sp > 1:
                     shape.append(sp); names.append("seq")
                 if tp > 1:
                     shape.append(tp); names.append("model")
                 if ep > 1:
                     shape.append(ep); names.append("expert")
+                if pp > 1:
+                    shape.append(pp); names.append("pipe")
                 mesh = make_mesh(tuple(shape), tuple(names))
         self.mesh = mesh
 
@@ -214,9 +229,28 @@ class ZeroEngine:
         self.seq_axis = _axis("seq")
         self.model_axis = _axis("model")
         self.expert_axis = _axis("expert")
+        self.pipe_axis = _axis("pipe")
+        # re-check on the RESOLVED axes: an explicit mesh with both "seq"
+        # and "pipe" axes bypasses the kwarg guard above
+        if self.seq_axis is not None and self.pipe_axis is not None:
+            raise ValueError(
+                "a mesh with both 'seq' and 'pipe' axes is unsupported "
+                "(ring attention's shard_map cannot nest inside the "
+                "pipeline's manual region)"
+            )
+        if self.pipe_axis is not None and not getattr(
+            model, "pipeline_capable", False
+        ):
+            raise ValueError(
+                f"{type(model).__name__} does not implement the pipeline "
+                "forward (pipeline_capable=False); pipeline_parallel would "
+                "silently run un-pipelined with the layer axis sharded"
+            )
         self.pctx = ParallelContext(
             mesh=mesh, data_axis="data", seq_axis=self.seq_axis,
             model_axis=self.model_axis, expert_axis=self.expert_axis,
+            pipe_axis=self.pipe_axis,
+            pipe_microbatches=int(pipeline_microbatches or 0),
         )
         self.accum_steps = int(accum_steps)
         self.n_dev = mesh.devices.size
@@ -259,6 +293,20 @@ class ZeroEngine:
                         f"divisible by {ax_attr} axis size {size}"
                     )
                 reserved.setdefault(name, {})[dim] = ax_attr
+
+        if self.pipe_axis is not None:
+            # each pipeline stage owns a contiguous slab of the stacked
+            # (n_layer, ...) block tensors: leading axis sharded over "pipe"
+            pp_size = mesh.shape[self.pipe_axis]
+            for name, s in shapes.items():
+                if not name.startswith("h."):
+                    continue
+                if s.shape[0] % pp_size:
+                    raise ValueError(
+                        f"n_layer={s.shape[0]} not divisible by "
+                        f"pipeline_parallel={pp_size}"
+                    )
+                reserved.setdefault(name, {})[0] = self.pipe_axis
 
         specs = _param_spec_tree(shapes, self.n_shard, reserved)
         self._shard_spec = specs  # even-shard spec per param

@@ -93,6 +93,7 @@ class ParallelContext:
     model_axis: _Optional[str] = None
     expert_axis: _Optional[str] = None
     pipe_axis: _Optional[str] = None
+    pipe_microbatches: int = 0
 
     @property
     def is_multi_device(self) -> bool:
@@ -114,6 +115,12 @@ class ParallelContext:
         return (
             self.expert_axis is not None
             and self.mesh.shape[self.expert_axis] > 1
+        )
+
+    @property
+    def pipe_parallel(self) -> bool:
+        return (
+            self.pipe_axis is not None and self.mesh.shape[self.pipe_axis] > 1
         )
 
 

@@ -1,0 +1,129 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a "pipe" mesh axis.
+
+ABSENT from the reference (SURVEY §2.20: its entire parallelism surface is
+DP + ZeRO-1/2/3) but first-class here: the stacked transformer blocks
+(the (n_layer, ...) "h.*" tensors the model scans over) shard their leading
+layer axis over a "pipe" mesh axis, so each pipeline stage *owns* a
+contiguous slab of n_layer/S layers — model memory scales 1/S per stage,
+like the layer-partition schemes the reference's ZeRO-3 only approximates
+per-tensor.
+
+TPU-first expression — one SPMD program, not a torch-style stage scheduler:
+  * `jax.shard_map` manual over ONLY the "pipe" axis (partial-manual mode);
+    the ZeRO "data" axis and the tensor-parallel "model" axis stay
+    compiler-managed inside the body, so pipeline composes with every ZeRO
+    stage and with Megatron TP without any extra code.
+  * the classic GPipe schedule becomes a `lax.scan` over M + S - 1 ticks:
+    stage 0 injects a fresh microbatch each tick, every stage applies its
+    local layer slab, and activations hop stage->stage+1 via
+    `jax.lax.ppermute` (neighbor ICI hop — the cheapest collective on a
+    TPU torus).
+  * the backward pipeline is free: autodiff transposes `ppermute` into the
+    reverse hop and reverses the tick scan, yielding the standard
+    1F-then-1B pipeline with bubble fraction (S-1)/(M+S-1).
+
+Bubble math: choose microbatches M >= S (default M = S); utilization is
+M/(M+S-1), so raise M to amortize the bubble (at O(T/M) activation memory
+per in-flight microbatch).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def spmd_pipeline(
+    block_fn,
+    stacked,
+    x,
+    *,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+    data_axis: Optional[str] = "data",
+    microbatches: Optional[int] = None,
+):
+    """Run `x` through the layer-stacked `stacked` params as an S-stage
+    GPipe pipeline over `pipe_axis`.
+
+    block_fn: (x, block_params) -> x, one transformer block.
+    stacked:  pytree of (n_layer, ...) tensors, n_layer % S == 0; leading
+              axis sharded over `pipe_axis` (each stage holds its slab).
+    x:        (B, T, D) activations, B % microbatches == 0.
+    Returns (B, T, D), numerically identical to `lax.scan(block_fn, x,
+    stacked)` (tested in tests/test_pipeline.py).
+    """
+    s = mesh.shape[pipe_axis]
+    m = int(microbatches) if microbatches else s
+    b = x.shape[0]
+    n_layer = jax.tree.leaves(stacked)[0].shape[0]
+    if n_layer % s:
+        raise ValueError(f"n_layer={n_layer} not divisible by pipeline "
+                         f"stages {s}")
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    if s == 1:
+        def body(c, bp):
+            return block_fn(c, bp), None
+        return jax.lax.scan(body, x, stacked)[0]
+
+    # Microbatch split OUTSIDE the shard_map: the M axis must be replicated
+    # (the tick loop dynamic-slices it) while the per-microbatch batch dim
+    # keeps the data sharding.
+    dtype = x.dtype
+    # On CPU only, activations cross the shard_map boundary in float32: the
+    # transpose of a replicated (unmapped) input is a psum over the manual
+    # axis, and XLA CPU's AllReducePromotion pass crashes cloning sub-f32
+    # all-reduces inside manual regions ("Invalid binary instruction opcode
+    # copy").  On TPU the native dtype goes through (half the HBM/ICI bytes).
+    boundary_dtype = (
+        jnp.float32 if jax.default_backend() == "cpu" else dtype
+    )
+    xmb = x.reshape(m, b // m, *x.shape[1:]).astype(boundary_dtype)
+    if data_axis is not None and data_axis in mesh.axis_names:
+        xmb = jax.lax.with_sharding_constraint(
+            xmb, NamedSharding(mesh, P(None, data_axis))
+        )
+
+    def local(stacked_loc, xmb):
+        xmb = xmb.astype(dtype)
+        stage = jax.lax.axis_index(pipe_axis)
+        state = jnp.zeros(xmb.shape[1:], xmb.dtype)
+        shift = [(i, i + 1) for i in range(s - 1)]  # no wrap: stage 0 injects
+
+        def tick(state, t):
+            inj = jax.lax.dynamic_index_in_dim(
+                xmb, jnp.clip(t, 0, m - 1), 0, keepdims=False
+            )
+            state = jnp.where(stage == 0, inj, state)
+
+            def layer(c, bp):
+                return block_fn(c, bp), None
+
+            state, _ = jax.lax.scan(layer, state, stacked_loc)
+            out = state
+            state = jax.lax.ppermute(state, pipe_axis, shift)
+            return state, out
+
+        _, outs = jax.lax.scan(tick, state, jnp.arange(m + s - 1))
+        # microbatch j leaves the last stage at tick j + s - 1
+        y = outs[s - 1 : s - 1 + m]
+        # only the last stage's copy is the real output; psum broadcasts it
+        # (in boundary_dtype — see the CPU AllReducePromotion note above)
+        y = jnp.where(stage == s - 1, y.astype(boundary_dtype),
+                      jnp.zeros(y.shape, boundary_dtype))
+        return jax.lax.psum(y, pipe_axis)
+
+    specs = jax.tree.map(lambda _: P(pipe_axis), stacked)
+    y = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(specs, P()),
+        out_specs=P(),
+        axis_names={pipe_axis},
+        check_vma=False,
+    )(stacked, xmb)
+    return y.reshape(b, *x.shape[1:]).astype(dtype)
