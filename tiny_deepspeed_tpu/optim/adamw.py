@@ -28,7 +28,17 @@ from .base import Optimizer
 class AdamW(Optimizer):
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=1e-2, amsgrad=False, maximize=False,
-                 decoupled=False):
+                 decoupled=False, fused=False):
+        """fused: True/"auto" uses the Pallas one-VMEM-pass update kernel
+        (optim/adamw_pallas.py; "auto" restricts it to single-device TPU,
+        True forces it on single-device TPU/interpret); False (default) uses
+        the XLA path.  Default is False on measurement: the XLA update fuses into
+        the surrounding step graph and beats the standalone kernel ~15%
+        end-to-end on v5e (84.4k vs 71.7k tokens/s, gpt2-124m B=8) — the
+        custom-call boundary costs more than the kernel saves on a purely
+        bandwidth-bound op.  Multi-device always uses XLA — a Pallas custom
+        call cannot be GSPMD-partitioned, so on ZeRO-sharded state it would
+        force an all-gather."""
         super().__init__(lr)
         self.b1, self.b2 = betas
         self.eps = eps
@@ -36,6 +46,23 @@ class AdamW(Optimizer):
         self.amsgrad = amsgrad
         self.maximize = maximize
         self.decoupled = decoupled
+        self.fused = fused
+
+    def _use_fused(self, param) -> bool:
+        if self.fused is False or self.amsgrad:
+            return False
+        import jax
+
+        from .adamw_pallas import INTERPRET, pallas_supported
+        if not pallas_supported(param):
+            return False
+        # multi-device ALWAYS refuses (even fused=True): the custom call
+        # cannot be GSPMD-partitioned, so sharded state would all-gather
+        if jax.device_count() != 1:
+            return False
+        # the kernel only lowers via Mosaic (TPU) or interpret mode; other
+        # backends fall back to XLA for both "auto" and True
+        return jax.default_backend() == "tpu" or INTERPRET
 
     def init_one(self, name, param):
         z = jnp.zeros(param.shape, jnp.float32)
@@ -45,6 +72,15 @@ class AdamW(Optimizer):
         return state
 
     def update_one(self, name, param, grad, state, step):
+        if self._use_fused(param):
+            from .adamw_pallas import adamw_update_pallas
+            new_p, m, v = adamw_update_pallas(
+                param, grad, state["m"], state["v"], step,
+                lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
+                wd=self.weight_decay, decoupled=self.decoupled,
+                maximize=self.maximize,
+            )
+            return new_p, {"m": m, "v": v}
         g = grad.astype(jnp.float32)
         p = param.astype(jnp.float32)
         if self.maximize:

@@ -1,0 +1,115 @@
+"""Pallas fused AdamW update: one VMEM pass per parameter slab.
+
+The reference's optimizer hot loop is a *python* per-param iteration issuing
+~10 separate CUDA kernels per tensor (reference core/optim/base.py:15-20,
+adamw.py:32-59).  The XLA path here already fuses the whole update into one
+elementwise loop per leaf; this kernel goes one step further and is the
+"fused optimizer kernel" north star (SURVEY §2.9): param + grad + m + v
+stream through VMEM exactly once, with the update math done in registers —
+the update is purely HBM-bandwidth-bound, so one pass is the floor.
+
+Partitioning caveat: a Pallas kernel is a custom call, which GSPMD cannot
+auto-partition — on a ZeRO-sharded leaf it would force an all-gather.  The
+dispatch in optim/adamw.py therefore enables this kernel only when no
+partitioning is in play (single device); multi-device uses the XLA fusion,
+which partitions for free.
+
+Measured verdict (v5e-1, gpt2-124m B=8 T=1024): the XLA path wins — 84.4k
+tokens/s vs 71.7k with this kernel — because XLA fuses the update into the
+producing step graph while a custom call forces p/g/m/v to materialize at
+the boundary.  The kernel is kept as the reference-parity "hand-written
+optimizer kernel" capability behind `AdamW(fused=True)`; the default stays
+on the fusion path that measurement favors.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 1024        # flat view is (rows, LANE); LANE = 8 sublanes * 128 lanes
+ROW_BLOCK = 64     # 64*1024*4B*7 arrays ~ 1.8 MB of VMEM per grid step
+MIN_SIZE = 8 * LANE  # leaves smaller than this stay on the XLA path
+
+INTERPRET = bool(os.environ.get("TDS_PALLAS_INTERPRET"))
+
+
+def pallas_supported(param) -> bool:
+    return param.dtype == jnp.float32 and param.size >= MIN_SIZE
+
+
+def _kernel(c_ref, p_ref, g_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref,
+            *, lr, b1, b2, eps, wd, decoupled, maximize):
+    c1 = c_ref[0, 0]  # 1 - b1^t   (bias corrections; traced scalars)
+    c2 = c_ref[0, 1]  # 1 - b2^t
+    p = p_ref[...]
+    g = g_ref[...].astype(jnp.float32)
+    if maximize:
+        g = -g
+    if wd and not decoupled:
+        g = g + wd * p  # reference adamw.py:37-38 (L2-into-grad)
+    m = b1 * m_ref[...] + (1.0 - b1) * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
+    if wd and decoupled:
+        upd = upd + wd * p
+    po_ref[...] = p - lr * upd
+    mo_ref[...] = m
+    vo_ref[...] = v
+
+
+def adamw_update_pallas(param, grad, m, v, step, *, lr, b1, b2, eps, wd,
+                        decoupled=False, maximize=False):
+    """Fused update for one float32 leaf.  Returns (new_param, new_m, new_v).
+
+    Flattens to a (rows, LANE) slab (zero-padded tail: zeros update to
+    zeros, so padding is inert) and streams row blocks through VMEM.
+    """
+    n = param.size
+    shape = param.shape
+    # pad to a multiple of 8 rows (one full sublane tile) so the row-block
+    # search below never degrades under the 8-row floor (padding is inert:
+    # zero p/g/m/v update to zeros)
+    pad = (-n) % (8 * LANE)
+    flat = lambda x, d: jnp.pad(x.reshape(-1).astype(d), (0, pad))
+    pf = flat(param, jnp.float32)
+    gf = flat(grad, jnp.float32)
+    mf = flat(m, jnp.float32)
+    vf = flat(v, jnp.float32)
+    rows = pf.size // LANE
+    # rb must divide rows AND be a multiple of 8 (Mosaic sublane tiling);
+    # rows is a multiple of 8 by the padding above, so rb=8 always works
+    rb = 8
+    for cand in range(min(ROW_BLOCK, rows) // 8 * 8, 7, -8):
+        if rows % cand == 0:
+            rb = cand
+            break
+
+    t = step.astype(jnp.float32)
+    c = jnp.stack([1.0 - jnp.power(b1, t), 1.0 - jnp.power(b2, t)])
+    c = c.reshape(1, 2)
+
+    view = lambda x: x.reshape(rows, LANE)
+    tile = pl.BlockSpec((rb, LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    scal = pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    kern = functools.partial(
+        _kernel, lr=lr, b1=b1, b2=b2, eps=eps, wd=wd,
+        decoupled=decoupled, maximize=maximize,
+    )
+    po, mo, vo = pl.pallas_call(
+        kern,
+        grid=(rows // rb,),
+        in_specs=[scal, tile, tile, tile, tile],
+        out_specs=[tile, tile, tile],
+        out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)] * 3,
+        interpret=INTERPRET,
+    )(c, view(pf), view(gf), view(mf), view(vf))
+
+    unview = lambda x: x.reshape(-1)[:n].reshape(shape)
+    return unview(po).astype(param.dtype), unview(mo), unview(vo)
