@@ -60,8 +60,17 @@ class GPTConfig:
     remat: bool = True
     # which intermediates the block remat may keep instead of recomputing:
     # "nothing" | "dots" | "dots_no_batch" | "all"  (measured on v5e-1,
-    # GPT-2 124M B=8: within noise of each other; "nothing" minimizes HBM)
-    remat_policy: str = "nothing"
+    # GPT-2 124M B=8: dots_no_batch ~84.0k tok/s vs nothing ~80.3k;
+    # "nothing" still minimizes HBM)
+    remat_policy: str = "dots_no_batch"
+    # chunked lm_head+loss (never materializes full (B, T, V) logits;
+    # ops/softmax_xent.fused_linear_xent).  A MEMORY knob, not a speed knob:
+    # measured v5e-1 gpt2-124m B=8 T=1024 it costs ~8% (77.0k vs 83.8k
+    # tok/s — backward recomputes the lm_head matmul) while capping live
+    # logits at chunk/T of full; enable for long-T / tight-HBM configs
+    # where full (B, T, V) logits would not fit.  Falls back automatically
+    # under sequence parallelism (chunking would slice the sharded T axis).
+    fused_xent: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -241,13 +250,20 @@ class GPT2Model:
             block = jax.checkpoint(block, policy=self.remat_policy())
         return block
 
-    def head(self, params, x, targets: Optional[jax.Array] = None):
+    def head(self, params, x, targets: Optional[jax.Array] = None,
+             pctx=None):
         """Final layernorm + lm_head (+ loss when targets given)."""
         c = self.config
         cd = c.compute_dtype
         x = layernorm(x, params["ln_f.w"].astype(cd), params["ln_f.b"].astype(cd))
 
         if targets is not None:
+            seq_sharded = pctx is not None and pctx.seq_parallel
+            if c.fused_xent and not seq_sharded:
+                from ..ops.softmax_xent import fused_linear_xent
+                return fused_linear_xent(
+                    x, params["lm_head.w"].astype(cd), targets
+                )
             logits = linear(x, params["lm_head.w"].astype(cd), None)
             return softmax_cross_entropy(logits, targets)
         # inference path: last position only (cheap lm_head)
@@ -282,7 +298,7 @@ class GPT2Model:
                 return block(x, bp), None
 
             x, _ = jax.lax.scan(scan_body, x, stacked)
-        return self.head(params, x, targets)
+        return self.head(params, x, targets, pctx)
 
     def __call__(self, params, idx, targets=None, pctx=None):
         return self.apply(params, idx, targets, pctx)

@@ -227,7 +227,7 @@ class MoEGPT(GPT2Model):
             block, (x, jnp.zeros((), jnp.float32)), stacked
         )
 
-        out = self.head(params, x, targets)
+        out = self.head(params, x, targets, pctx)
         if targets is not None:
             return out + c.aux_loss_weight * aux_sum / c.n_layer
         return out

@@ -22,3 +22,104 @@ def softmax_cross_entropy(logits, targets):
         logits, targets[..., None], axis=-1
     ).squeeze(-1)
     return jnp.mean(logz - gold)
+
+
+# ---------------------------------------------------------------------------
+# fused lm_head matmul + cross-entropy (chunked over the sequence)
+# ---------------------------------------------------------------------------
+
+def _pick_chunk(t: int, want: int) -> int:
+    """Largest chunk <= want that divides t; t itself when the only such
+    divisor would be degenerate (< 32 rows per chunk wastes the MXU on
+    (B, tiny, V) matmuls — better to take one full-size chunk)."""
+    for c in range(min(want, t), 31, -1):
+        if t % c == 0:
+            return c
+    return t
+
+
+def _chunk_iter_fwd(x, w, targets, chunk):
+    """Scan over sequence chunks: returns (loss_sum f32 scalar, logz (B,T))."""
+    b, t, _ = x.shape
+    nc = t // chunk
+
+    def body(acc, ci):
+        start = ci * chunk
+        xc = jax.lax.dynamic_slice_in_dim(x, start, chunk, axis=1)
+        tc = jax.lax.dynamic_slice_in_dim(targets, start, chunk, axis=1)
+        logits = jnp.einsum(
+            "btd,dv->btv", xc, w, preferred_element_type=jnp.float32
+        )
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)  # (B, chunk)
+        gold = jnp.take_along_axis(
+            logits, tc[..., None], axis=-1
+        ).squeeze(-1)
+        return acc + jnp.sum(logz - gold), logz
+
+    acc, logz = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                             jnp.arange(nc))
+    # logz stacked (nc, B, chunk) -> (B, T)
+    return acc, jnp.moveaxis(logz, 0, 1).reshape(b, t)
+
+
+@jax.custom_vjp
+def fused_linear_xent(x, w, targets):
+    """mean NLL of logits = x @ w without materializing the full (B, T, V)
+    logits tensor: forward and backward both stream (B, chunk, V) slabs.
+
+    x (B, T, D); w (D, V); targets (B, T) int.  At GPT-2 vocab (50304) the
+    full logits are ~25x the activations they come from — this op caps the
+    live logits footprint at T/chunk of that and recomputes them in the
+    backward (flash-attention-style recompute-over-materialize, applied to
+    the loss head).  Replaces the reference's full-logits
+    F.cross_entropy(logits.view(-1, V), ...) (reference example/model.py:
+    154-156)."""
+    chunk = _pick_chunk(x.shape[1], 128)
+    loss_sum, _ = _chunk_iter_fwd(x, w, targets, chunk)
+    return loss_sum / (x.shape[0] * x.shape[1])
+
+
+def _flx_fwd_rule(x, w, targets):
+    chunk = _pick_chunk(x.shape[1], 128)
+    loss_sum, logz = _chunk_iter_fwd(x, w, targets, chunk)
+    n = x.shape[0] * x.shape[1]
+    return loss_sum / n, (x, w, targets, logz)
+
+
+def _flx_bwd_rule(res, g):
+    x, w, targets, logz = res
+    b, t, d = x.shape
+    v = w.shape[1]
+    chunk = _pick_chunk(t, 128)
+    nc = t // chunk
+    scale = g / (b * t)
+
+    def body(dw_acc, ci):
+        start = ci * chunk
+        xc = jax.lax.dynamic_slice_in_dim(x, start, chunk, axis=1)
+        tc = jax.lax.dynamic_slice_in_dim(targets, start, chunk, axis=1)
+        lzc = jax.lax.dynamic_slice_in_dim(logz, start, chunk, axis=1)
+        logits = jnp.einsum(
+            "btd,dv->btv", xc, w, preferred_element_type=jnp.float32
+        )
+        p = jnp.exp(logits - lzc[..., None])
+        vocab = jax.lax.broadcasted_iota(jnp.int32, p.shape, 2)
+        p = jnp.where(vocab == tc[..., None], p - 1.0, p) * scale
+        pc = p.astype(x.dtype)  # grads flow at compute precision
+        dxc = jnp.einsum(
+            "btv,dv->btd", pc, w, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        dw_acc = dw_acc + jnp.einsum(
+            "btd,btv->dv", xc, pc, preferred_element_type=jnp.float32
+        )
+        return dw_acc, dxc
+
+    dw, dx = jax.lax.scan(body, jnp.zeros((d, v), jnp.float32),
+                          jnp.arange(nc))
+    dx = jnp.moveaxis(dx, 0, 1).reshape(b, t, d)
+    import numpy as np
+    zero = np.zeros(targets.shape, dtype=jax.dtypes.float0)
+    return dx, dw.astype(w.dtype), zero
+
+
+fused_linear_xent.defvjp(_flx_fwd_rule, _flx_bwd_rule)
