@@ -61,15 +61,62 @@ def make_mesh(
     axis_names: Tuple[str, ...] = ("data",),
     devices=None,
 ) -> Mesh:
-    """Mesh over all devices; default one "data" axis spanning everything."""
-    devices = np.asarray(devices if devices is not None else jax.devices())
+    """Mesh over all devices; default one "data" axis spanning everything.
+
+    On a multi-slice/multi-host topology (devices carrying distinct
+    slice_index / process_index), the device grid is laid out hybrid: the
+    slow DCN network carries the leading "data" axis (gradient reductions
+    amortize over the whole step) while every other axis — "model", "seq",
+    "expert", "pipe", whose collectives sit on the critical path — stays
+    inside a slice on ICI.  The reference is single-node only (its
+    README.md:70 TODO "multi-node"); here the same mesh code spans both.
+    """
+    devices = list(devices if devices is not None else jax.devices())
     if shape is None:
-        shape = (devices.size,) + (1,) * (len(axis_names) - 1)
-    if int(np.prod(shape)) != devices.size:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    shape = tuple(int(s) for s in shape)
+    if int(np.prod(shape)) != len(devices):
         raise ValueError(
-            f"mesh shape {tuple(shape)} != device count {devices.size}"
+            f"mesh shape {shape} != device count {len(devices)}"
         )
-    return Mesh(devices.reshape(shape), axis_names)
+    grid = _device_grid(shape, axis_names, devices)
+    return Mesh(grid, axis_names)
+
+
+def _n_granules(devices) -> Tuple[int, str]:
+    """(number of DCN granules, granule attr name) for these devices.
+
+    Granules must be equal-sized for a hybrid layout (mesh_utils builds one
+    ICI mesh per granule); uneven subsets report 1 so callers fall back to
+    the flat reshape."""
+    from collections import Counter
+
+    for attr in ("slice_index", "process_index"):
+        if hasattr(devices[0], attr):
+            counts = Counter(getattr(d, attr) for d in devices)
+            if len(counts) > 1 and len(set(counts.values())) == 1:
+                return len(counts), attr
+    return 1, ""
+
+
+def _device_grid(shape, axis_names, devices) -> np.ndarray:
+    """Device ndarray for Mesh: hybrid ICI x DCN when the devices span
+    multiple slices/processes and the data axis can absorb them; plain
+    reshape (single-granule, or indivisible data axis) otherwise."""
+    n_gran, attr = _n_granules(devices)
+    data_ix = axis_names.index("data") if "data" in axis_names else 0
+    if n_gran > 1 and shape[data_ix] % n_gran == 0:
+        from jax.experimental import mesh_utils
+
+        ici = list(shape)
+        dcn = [1] * len(shape)
+        ici[data_ix] = shape[data_ix] // n_gran
+        dcn[data_ix] = n_gran
+        return mesh_utils.create_hybrid_device_mesh(
+            ici, dcn, devices,
+            process_is_granule=(attr == "process_index"),
+        )
+    return np.asarray(devices).reshape(shape)
 
 
 import dataclasses
