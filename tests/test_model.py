@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tiny_deepspeed_tpu import GPTConfig, GPT2Model
 
@@ -76,3 +77,75 @@ class TestGPT2:
         grads = jax.grad(lambda p: model.apply(p, idx, tgt))(params)
         for name, g in grads.items():
             assert bool(jnp.any(g != 0)), f"zero grad for {name}"
+
+
+class TestGenerate:
+    """Autoregressive sampling API (no reference counterpart — its model
+    only trains; models/gpt2.py generate())."""
+
+    def _model(self):
+        from tiny_deepspeed_tpu import GPT2Model, GPTConfig
+        cfg = GPTConfig(block_size=32, vocab_size=64, n_layer=2, n_head=2,
+                        n_embd=16, compute_dtype=jnp.float32)
+        m = GPT2Model(cfg)
+        return m, m.init(jax.random.PRNGKey(0))
+
+    def test_shapes_and_prompt_preserved(self):
+        m, params = self._model()
+        idx = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        out = m.generate(params, idx, 5, key=jax.random.PRNGKey(1))
+        assert out.shape == (2, 8)
+        np.testing.assert_array_equal(np.asarray(out[:, :3]),
+                                      np.asarray(idx))
+        assert int(jnp.max(out)) < m.config.vocab_size
+
+    def test_greedy_is_deterministic(self):
+        m, params = self._model()
+        idx = jnp.array([[7, 8]], jnp.int32)
+        a = m.generate(params, idx, 6, temperature=0.0)
+        b = m.generate(params, idx, 6, temperature=0.0,
+                       key=jax.random.PRNGKey(99))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_padding_is_inert(self):
+        """Greedy continuation must not depend on buffer slack beyond the
+        prompt (causality + zero-pad discipline)."""
+        m, params = self._model()
+        idx = jnp.array([[7, 8, 9]], jnp.int32)
+        out_a = m.generate(params, idx, 2, temperature=0.0)
+        # same prompt, one fewer free slot used: first new token must agree
+        out_b = m.generate(params, idx, 1, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(out_a[:, :4]),
+                                      np.asarray(out_b))
+
+    def test_rejects_overflow(self):
+        m, params = self._model()
+        idx = jnp.zeros((1, 30), jnp.int32)
+        with pytest.raises(ValueError, match="block_size"):
+            m.generate(params, idx, 5)
+
+    def test_requires_key_for_sampling(self):
+        m, params = self._model()
+        idx = jnp.array([[1, 2]], jnp.int32)
+        with pytest.raises(ValueError, match="PRNG key"):
+            m.generate(params, idx, 2)  # temperature=1.0, no key
+
+    def test_jit_cache_reused(self):
+        m, params = self._model()
+        idx = jnp.array([[1, 2]], jnp.int32)
+        m.generate(params, idx, 3, temperature=0.0)
+        assert len(m._generate_cache) == 1
+        m.generate(params, idx, 3, temperature=0.0)
+        assert len(m._generate_cache) == 1  # same shapes -> no new trace
+
+    def test_moe_generate(self):
+        from tiny_deepspeed_tpu import MoEConfig, MoEGPT
+        cfg = MoEConfig(block_size=32, vocab_size=64, n_layer=2, n_head=2,
+                        n_embd=16, n_expert=2, compute_dtype=jnp.float32)
+        m = MoEGPT(cfg)
+        params = m.init(jax.random.PRNGKey(0))
+        idx = jnp.array([[1, 2, 3]], jnp.int32)
+        out = m.generate(params, idx, 4, temperature=0.0)
+        assert out.shape == (1, 7)
+        np.testing.assert_array_equal(np.asarray(out[:, :3]),
+                                      np.asarray(idx))

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from functools import partial
 from typing import Any, Dict, Optional
 
 import jax
@@ -101,6 +102,7 @@ class GPT2Model:
 
     def __init__(self, config: GPTConfig):
         self.config = config
+        self._generate_cache = {}  # (shape, sampling) -> jitted decode
 
     # -- initialization ----------------------------------------------------
 
@@ -251,7 +253,7 @@ class GPT2Model:
         return block
 
     def head(self, params, x, targets: Optional[jax.Array] = None,
-             pctx=None):
+             pctx=None, position=None):
         """Final layernorm + lm_head (+ loss when targets given)."""
         c = self.config
         cd = c.compute_dtype
@@ -266,12 +268,17 @@ class GPT2Model:
                 )
             logits = linear(x, params["lm_head.w"].astype(cd), None)
             return softmax_cross_entropy(logits, targets)
-        # inference path: last position only (cheap lm_head)
-        logits = linear(x[:, -1:], params["lm_head.w"].astype(cd), None)
+        # inference path: one position only (cheap lm_head) — `position`
+        # (static or traced int) selects it, default the last
+        if position is None:
+            x = x[:, -1:]
+        else:
+            x = jax.lax.dynamic_slice_in_dim(x, position, 1, axis=1)
+        logits = linear(x, params["lm_head.w"].astype(cd), None)
         return logits.astype(jnp.float32)
 
     def apply(self, params, idx, targets: Optional[jax.Array] = None,
-              pctx=None):
+              pctx=None, position=None):
         """Forward pass.  Returns mean loss if targets given, else logits —
         same contract as reference GPT2Model.forward (model.py:139-157).
 
@@ -298,7 +305,78 @@ class GPT2Model:
                 return block(x, bp), None
 
             x, _ = jax.lax.scan(scan_body, x, stacked)
-        return self.head(params, x, targets, pctx)
+        return self.head(params, x, targets, pctx, position)
 
     def __call__(self, params, idx, targets=None, pctx=None):
         return self.apply(params, idx, targets, pctx)
+
+    def generate(self, params, idx, max_new_tokens: int, *,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 key=None):
+        """Autoregressive sampling: (B, T0) prompt -> (B, T0+max_new_tokens).
+
+        The reference has no sampling loop (its model only trains); this is
+        the capability users expect from a GPT training framework.  TPU-first
+        shape discipline: the token buffer is a FIXED (B, block_size) array
+        updated in place and the decode loop is a `lax.fori_loop` inside one
+        cached jit (keyed on shapes + sampling settings, so repeat calls
+        don't retrace); causal attention makes the zero-padded future
+        positions inert, and each step projects only the single position it
+        samples from (`head(position=...)`).  temperature=0 gives greedy
+        decoding and needs no key; stochastic sampling requires an explicit
+        PRNG key (no silent fixed seed).
+        """
+        c = self.config
+        b, t0 = idx.shape
+        if t0 + max_new_tokens > c.block_size:
+            raise ValueError(
+                f"prompt {t0} + new {max_new_tokens} tokens > "
+                f"block_size {c.block_size}"
+            )
+        if key is None:
+            if temperature != 0.0:
+                raise ValueError(
+                    "stochastic sampling (temperature != 0) requires an "
+                    "explicit PRNG key; pass key=jax.random.PRNGKey(...) "
+                    "or use temperature=0.0 for greedy decoding"
+                )
+            key = jax.random.PRNGKey(0)  # unused by the greedy path
+
+        cache_key = (b, t0, max_new_tokens, temperature, top_k)
+        fn = self._generate_cache.get(cache_key)
+        if fn is None:
+            fn = jax.jit(
+                partial(
+                    self._generate_impl, t0=t0,
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature, top_k=top_k,
+                )
+            )
+            self._generate_cache[cache_key] = fn
+        return fn(params, idx, key)
+
+    def _generate_impl(self, params, idx, key, *, t0, max_new_tokens,
+                       temperature, top_k):
+        c = self.config
+        b = idx.shape[0]
+        buf = jnp.zeros((b, c.block_size), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, idx.astype(jnp.int32), (0, 0))
+
+        def body(i, carry):
+            buf, key = carry
+            logit = self.apply(params, buf, position=i - 1)[:, 0]  # (B, V)
+            if top_k is not None:
+                kth = jax.lax.top_k(logit, top_k)[0][:, -1:]
+                logit = jnp.where(logit < kth, -jnp.inf, logit)
+            key, sub = jax.random.split(key)
+            if temperature == 0.0:
+                nxt = jnp.argmax(logit, axis=-1).astype(jnp.int32)
+            else:
+                nxt = jax.random.categorical(
+                    sub, logit / temperature
+                ).astype(jnp.int32)
+            buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+            return buf, key
+
+        buf, _ = jax.lax.fori_loop(t0, t0 + max_new_tokens, body, (buf, key))
+        return buf[:, : t0 + max_new_tokens]

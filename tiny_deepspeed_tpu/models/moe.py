@@ -210,7 +210,7 @@ class MoEGPT(GPT2Model):
         }
 
     def apply(self, params, idx, targets: Optional[jax.Array] = None,
-              pctx=None):
+              pctx=None, position=None):
         c = self.config
         x = self.embed(params, idx, pctx)
         stacked = self.stacked_compute_params(params)
@@ -227,7 +227,7 @@ class MoEGPT(GPT2Model):
             block, (x, jnp.zeros((), jnp.float32)), stacked
         )
 
-        out = self.head(params, x, targets, pctx)
+        out = self.head(params, x, targets, pctx, position)
         if targets is not None:
             return out + c.aux_loss_weight * aux_sum / c.n_layer
         return out
