@@ -2,13 +2,29 @@
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
+Hardened against transient TPU-backend outages (round 1 shipped rc=1 when
+`jax.devices()` returned UNAVAILABLE at init): backend init failures re-exec
+the script with backoff up to BENCH_MAX_ATTEMPTS; the FINAL failure emits a
+diagnostic JSON line (value 0, error in extra) instead of a traceback.
+
 The reference publishes no numbers (BASELINE.md), so `vs_baseline` is measured
 against this repo's own previous round (BENCH_r*.json if present, else 1.0).
 Headline metric: GPT-2 124M tokens/sec/chip on the reference demo workload
 shape (T=1024, AdamW — reference example/ddp/train.py:23-35), batch size
 scaled to fill the chip.
+
+MFU is reported two ways (round-1 verdict: the 6N formula flatters itself by
+counting embedding params whose forward is a gather):
+  * `matmul_mfu` — honest: 6 * non-embedding params (wte/wpe excluded,
+    lm_head kept: it is a matmul) + 12*L*T*d attention FLOPs per token
+    (PaLM-appendix convention, no causal discount).
+  * `mfu_6n` — the naive 6 * total-params number, for comparability.
+
+`python bench.py --sweep` measures every single-chip row of the BASELINE.md
+matrix (124M / 350M / 774M / 1.5B) and prints one JSON line per config.
 """
 
+import dataclasses
 import glob
 import json
 import os
@@ -17,11 +33,55 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
+MAX_ATTEMPTS = int(os.environ.get("BENCH_MAX_ATTEMPTS", "5"))
 
 
-def measure(engine, state, batch, warmup=3, iters=10):
+def _retry_or_diagnose(exc: BaseException) -> None:
+    """Transient backend failure -> sleep + re-exec (clean process, clean
+    backend state); final failure -> ONE diagnostic JSON line, rc 0.
+
+    "Transient" matches ONLY the init-time outage signatures (UNAVAILABLE /
+    'Unable to initialize backend') — a broader match would sleep-and-re-exec
+    deterministic failures (OOM, lowering errors) five times for nothing."""
+    attempt = int(os.environ.get("BENCH_ATTEMPT", "0"))
+    r = repr(exc)
+    transient = "UNAVAILABLE" in r or "Unable to initialize backend" in r
+    if transient and attempt + 1 < MAX_ATTEMPTS:
+        delay = min(60, 10 * (2 ** attempt))
+        print(
+            f"bench: backend unavailable (attempt {attempt + 1}/"
+            f"{MAX_ATTEMPTS}), retrying in {delay}s: {exc!r}",
+            file=sys.stderr,
+        )
+        time.sleep(delay)
+        env = dict(os.environ, BENCH_ATTEMPT=str(attempt + 1))
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-124m")
+    print(json.dumps({
+        "metric": f"{model_name}_train_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "extra": {
+            "error": repr(exc)[:500],
+            "attempts": attempt + 1,
+            "transient": transient,
+        },
+    }))
+    sys.exit(0)
+
+
+def _peak_flops_per_chip(device) -> float:
+    """bf16 peak by device kind (used only for the MFU context numbers)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, peak in (("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+                      ("v6", 918e12), ("v4", 275e12)):
+        if tag in kind:
+            return peak
+    return 197e12
+
+
+def measure(engine, state, batch, warmup=5, iters=30):
     # NB: float(loss) (device->host transfer) is the sync barrier; on the
     # axon tunnel platform block_until_ready returns early.
     for _ in range(warmup):
@@ -35,32 +95,74 @@ def measure(engine, state, batch, warmup=3, iters=10):
     return dt / iters, state
 
 
-def main():
+def _bench_config(model_name: str):
+    """Per-model single-chip bench settings, measured on v5e-1 (16 GB):
+    124M fits without remat (fastest); 1.5B only fits fully-bf16 (params +
+    AdamW moments) with remat=nothing + the chunked fused lm_head/xent."""
+    import jax.numpy as jnp
+    table = {
+        "gpt2-124m": dict(batch=8, overrides=dict(remat=False),
+                          state_dtype=jnp.float32),
+        "gpt2-350m": dict(batch=8, overrides={}, state_dtype=jnp.float32),
+        "gpt2-774m": dict(batch=4, overrides=dict(fused_xent=True),
+                          state_dtype=jnp.bfloat16),
+        "gpt2-1.5b": dict(
+            batch=4,
+            overrides=dict(param_dtype=jnp.bfloat16, remat_policy="nothing",
+                           fused_xent=True),
+            state_dtype=jnp.bfloat16,
+        ),
+    }
+    return table.get(model_name,
+                     dict(batch=8, overrides={}, state_dtype=None))
+
+
+def run_one(model_name: str, b=None, t=1024, iters=30):
+    import jax
+    import jax.numpy as jnp
     from tiny_deepspeed_tpu import AdamW, GPT2Model, SingleDevice, make_mesh
     from tiny_deepspeed_tpu.models import GPT2_PRESETS
 
-    model_name = os.environ.get("BENCH_MODEL", "gpt2-124m")
-    b = int(os.environ.get("BENCH_BATCH", "8"))
-    t = int(os.environ.get("BENCH_SEQ", "1024"))
+    bc = _bench_config(model_name)
+    b = b or bc["batch"]
+    cfg = dataclasses.replace(GPT2_PRESETS[model_name], **bc["overrides"])
 
-    model = GPT2Model(GPT2_PRESETS[model_name])
-    n_chips = len(jax.devices())
+    if os.environ.get("BENCH_AUTOTUNE"):
+        # per-shape candidate timing at trace time (linear layouts, flash
+        # attention blocks, layernorm kernels) — winners baked into the step
+        from tiny_deepspeed_tpu.autotuner import (
+            RuntimeAutoTuner, set_default_tuner,
+        )
+        set_default_tuner(RuntimeAutoTuner(verbose=bool(
+            os.environ.get("BENCH_AUTOTUNE_VERBOSE"))))
+
+    model = GPT2Model(cfg)
+    devices = jax.devices()
+    n_chips = len(devices)
     mesh = make_mesh()
+    opt = AdamW(lr=1e-5, weight_decay=0.1,
+                state_dtype=bc["state_dtype"] or jnp.float32)
     if n_chips == 1:
-        engine = SingleDevice(model, AdamW(lr=1e-5, weight_decay=0.1),
-                              mesh=mesh)
+        engine = SingleDevice(model, opt, mesh=mesh)
     else:
         from tiny_deepspeed_tpu import Zero2
-        engine = Zero2(model, AdamW(lr=1e-5, weight_decay=0.1), mesh=mesh)
+        engine = Zero2(model, opt, mesh=mesh)
         b *= n_chips
 
     state = engine.init(jax.random.PRNGKey(0))
     idx = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0,
-                             model.config.vocab_size, jnp.int32)
+                             cfg.vocab_size, jnp.int32)
     tgt = jax.random.randint(jax.random.PRNGKey(2), (b, t), 0,
-                             model.config.vocab_size, jnp.int32)
+                             cfg.vocab_size, jnp.int32)
 
-    step_time, state = measure(engine, state, (idx, tgt))
+    if os.environ.get("BENCH_AUTOTUNE"):
+        # first trace records candidate requests; retune times them on the
+        # device and re-jits with winners baked (engine.retune docstring)
+        state, _ = engine.step(state, (idx, tgt))
+        tuned = engine.retune()
+        print(f"bench: autotuned {tuned} sites", file=sys.stderr)
+
+    step_time, state = measure(engine, state, (idx, tgt), iters=iters)
     tokens_per_sec_chip = b * t / step_time / n_chips
 
     # peak HBM/chip: live state + XLA temp from the compiled step
@@ -78,38 +180,99 @@ def main():
     except Exception:
         pass
 
-    # model FLOPs estimate (6 * params * tokens per fwd+bwd) for MFU context
+    # MFU, both accountings (module docstring).
     n_params = model.num_params()
-    flops_per_step = 6 * n_params * b * t
-    # v5e bf16 peak ~197 TFLOP/s/chip
-    mfu = flops_per_step / step_time / n_chips / 197e12
+    d, l, v = cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    embed_params = v * d + cfg.block_size * d  # wte + wpe (gather, not matmul)
+    flops_tok_matmul = 6 * (n_params - embed_params) + 12 * l * t * d
+    peak = _peak_flops_per_chip(devices[0])
+    toks_per_sec_total = b * t / step_time
+    matmul_mfu = flops_tok_matmul * toks_per_sec_total / n_chips / peak
+    mfu_6n = 6 * n_params * toks_per_sec_total / n_chips / peak
 
-    prev = 1.0
-    prior = sorted(glob.glob(os.path.join(os.path.dirname(__file__),
-                                          "BENCH_r*.json")))
-    if prior:
-        try:
-            with open(prior[-1]) as f:
-                prev_val = json.load(f).get("value")
-            if prev_val:
-                prev = tokens_per_sec_chip / prev_val
-        except Exception:
-            pass
-
-    print(json.dumps({
+    return {
         "metric": f"{model_name}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(prev, 3),
         "extra": {
             "chips": n_chips,
             "batch": b,
             "seq_len": t,
             "step_time_s": round(step_time, 4),
-            "approx_mfu": round(mfu, 3),
+            "matmul_mfu": round(matmul_mfu, 3),
+            "mfu_6n": round(mfu_6n, 3),
             "peak_hbm_gb_per_chip": hbm_gb,
+            "n_params_m": round(n_params / 1e6, 1),
+            "config": {
+                k: str(v) for k, v in _bench_config(model_name).items()
+            },
         },
-    }))
+    }
+
+
+def _vs_prev_round(value: float) -> float:
+    prev = 1.0
+    for path in sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")),
+            reverse=True):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            prev_val = rec.get("value")
+            if prev_val is None and isinstance(rec.get("parsed"), dict):
+                prev_val = rec["parsed"].get("value")
+            if prev_val:
+                return round(value / prev_val, 3)
+        except Exception:
+            continue
+    return prev
+
+
+def main():
+    sweep = "--sweep" in sys.argv
+    try:
+        import jax
+        jax.devices()  # backend init: the round-1 failure point
+    except Exception as e:  # noqa: BLE001 - diagnose/retry any init failure
+        _retry_or_diagnose(e)
+
+    if sweep:
+        models = ["gpt2-124m", "gpt2-350m", "gpt2-774m", "gpt2-1.5b"]
+        for name in models:
+            rec = None
+            for attempt in range(3):  # inline retry for transient outages
+                try:
+                    rec = run_one(name, iters=10 if "1.5b" in name or "774m"
+                                  in name else 30)
+                    rec["vs_baseline"] = 1.0
+                    break
+                except Exception as e:  # noqa: BLE001 - keep sweeping
+                    r = repr(e)
+                    rec = {
+                        "metric": f"{name}_train_tokens_per_sec_per_chip",
+                        "value": 0.0,
+                        "unit": "tokens/s/chip",
+                        "vs_baseline": 0.0,
+                        "extra": {"error": r[:300]},
+                    }
+                    if ("UNAVAILABLE" in r
+                            or "Unable to initialize backend" in r):
+                        time.sleep(20)
+                        continue
+                    break
+            print(json.dumps(rec), flush=True)
+        return
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-124m")
+    b = os.environ.get("BENCH_BATCH")
+    t = int(os.environ.get("BENCH_SEQ", "1024"))
+    try:
+        rec = run_one(model_name, b=int(b) if b else None, t=t)
+    except Exception as e:  # noqa: BLE001 - diagnose/retry
+        _retry_or_diagnose(e)
+        return
+    rec["vs_baseline"] = _vs_prev_round(rec["value"])
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":
