@@ -39,11 +39,16 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "(JAX host-platform trick; lets every ZeRO mode run without "
              "a pod — the reference has no such story, SURVEY §4)",
     )
-    p.add_argument("--model", default=default_model,
-                   choices=sorted(GPT2_PRESETS))
+    p.add_argument(
+        "--model", default=None, choices=sorted(GPT2_PRESETS),
+        help=f"default {default_model}; under --cpu-devices the default "
+             "drops to 'tiny' so every entry point smoke-tests in seconds "
+             "(XLA-CPU compile of a full-size step takes minutes)",
+    )
     p.add_argument("--iters", type=int, default=100)
     p.add_argument("--batch-per-device", type=int, default=1)
-    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="default min(1024, model block_size)")
     p.add_argument("--lr", type=float, default=1e-5)
     p.add_argument("--weight-decay", type=float, default=0.1)
     p.add_argument("--seed", type=int, default=0)
@@ -70,9 +75,28 @@ def parse_args(default_model="gpt2-124m", **defaults):
         help="binary uint16 token corpus (nanoGPT .bin convention); "
              "default: synthetic random tokens, the reference demo workload",
     )
+    p.add_argument(
+        "--save-every", type=int, default=0, metavar="N",
+        help="write a sharded Orbax checkpoint of the TrainState every N "
+             "iters into --save-dir (reference has no checkpointing, "
+             "SURVEY §5.4)",
+    )
+    p.add_argument("--save-dir", default="checkpoints", metavar="DIR")
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume from the latest checkpoint in --save-dir (restores "
+             "params+optimizer state into the engine's shardings and "
+             "fast-forwards the data stream, so the loss trajectory matches "
+             "an uninterrupted run)",
+    )
     if defaults:
         p.set_defaults(**defaults)
-    return p.parse_args()
+    args = p.parse_args()
+    if args.model is None:
+        args.model = "tiny" if args.cpu_devices else default_model
+    if args.seq_len is None:
+        args.seq_len = min(1024, GPT2_PRESETS[args.model].block_size)
+    return args
 
 
 def run(engine_cls, args, single_device=False):
@@ -104,9 +128,26 @@ def run(engine_cls, args, single_device=False):
         print(f"model={args.model} params={model.num_params()/1e6:.1f}M "
               f"global_batch={args.batch_per_device * n_dev} T={args.seq_len}")
 
-    state = engine.init(jax.random.PRNGKey(args.seed))
     b = args.batch_per_device * n_dev
     vocab = model.config.vocab_size
+
+    start_iter = 0
+    resume_step = None
+    if getattr(args, "resume", False):
+        from tiny_deepspeed_tpu.utils.checkpoint import (
+            latest_step, load_checkpoint,
+        )
+        resume_step = latest_step(args.save_dir)
+    if resume_step is not None:
+        # restore INSTEAD of init — materializing a fresh TrainState first
+        # would double peak state memory exactly on the near-HBM-limit runs
+        # checkpointing exists for
+        state = load_checkpoint(args.save_dir, engine, step=resume_step)
+        start_iter = resume_step
+        if jax.process_index() == 0:
+            print(f"resumed from {args.save_dir} at iter {resume_step}")
+    else:
+        state = engine.init(jax.random.PRNGKey(args.seed))
 
     # Native prefetching pipeline (C++ producer threads): batches are ready
     # before the device asks — the reference rebuilds tensors on the host
@@ -114,17 +155,26 @@ def run(engine_cls, args, single_device=False):
     from tiny_deepspeed_tpu.data import TokenLoader
     loader = TokenLoader(args.data, batch=b, seq=args.seq_len,
                          vocab_size=vocab, seed=args.seed)
+    for _ in range(start_iter):  # replay position -> trajectory continuity
+        loader.next()
 
     t0 = time.perf_counter()
-    for it in range(args.iters):
+    ran = 0
+    for it in range(start_iter, args.iters):
         idx, tgt = loader.next()
         state, loss = engine.step(state, (jnp.asarray(idx), jnp.asarray(tgt)))
+        ran += 1
         if jax.process_index() == 0:
             print(f"iter {it:3d} loss {float(loss):.4f}")
+        if getattr(args, "save_every", 0) and (it + 1) % args.save_every == 0:
+            from tiny_deepspeed_tpu.utils.checkpoint import save_checkpoint
+            save_checkpoint(args.save_dir, state, it + 1)
+            if jax.process_index() == 0:
+                print(f"saved checkpoint at iter {it + 1}")
     loader.close()
     dt = time.perf_counter() - t0
     if jax.process_index() == 0:
-        toks = args.iters * b * args.seq_len
-        print(f"done: {args.iters} iters in {dt:.1f}s "
+        toks = ran * b * args.seq_len
+        print(f"done: {ran} iters in {dt:.1f}s "
               f"({toks / dt:.0f} tokens/s)")
     return state

@@ -69,6 +69,31 @@ class TestGPT2:
         except ValueError:
             pass
 
+    def test_wte_max_norm_caps_used_rows(self):
+        """max_norm renorm wired through the forward (reference
+        nn.Embedding max_norm via ops/embedding.py:67-68): the gathered
+        token vectors come from a row-capped table, params untouched."""
+        import dataclasses
+        from tiny_deepspeed_tpu.ops.embedding import renorm_weight
+        cfg = dataclasses.replace(TINY, wte_max_norm=0.05)
+        model = GPT2Model(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        # make some rows exceed the cap
+        params["wte"] = params["wte"] * 100.0
+        idx = jnp.arange(32)[None, :] % cfg.vocab_size
+        x = model.embed(params, idx)
+        pos = params["wpe"][:32]
+        tok = x[0] - pos  # undo position add
+        norms = jnp.linalg.norm(tok, axis=-1)
+        assert float(norms.max()) <= 0.05 * 1.01
+        # stored table unchanged (functional renorm, not in-place)
+        assert float(jnp.abs(params["wte"]).max()) > 1.0
+        # loss path still works and differentiates
+        tgt = jnp.zeros_like(idx)
+        g = jax.grad(lambda p: model.apply(p, idx, tgt))(params)
+        assert float(jnp.abs(g["wte"]).sum()) > 0
+        del renorm_weight
+
     def test_grads_flow_to_all_params(self):
         model = GPT2Model(TINY)
         params = model.init(jax.random.PRNGKey(0))

@@ -27,16 +27,26 @@ Public API shape mirrors the reference's flat surface
     )
 """
 
-from .parallel.partition import partition_tensors
+from .parallel.partition import partition_tensors, materialize_owned
 from .parallel.engine import SingleDevice, DDP, Zero1, Zero2, Zero3
 from .parallel.mesh import make_mesh, init_distributed
 from .optim import SGD, AdamW
 from .models import GPTConfig, GPT2Model, MoEConfig, MoEGPT
 
-__version__ = "0.1.0"
+# Reference-shaped optimizer names (reference core/__init__.py:5-23 exports
+# DDPSGD/DDPAdamW/Zero{1,2,3}SGD/Zero{1,2,3}AdamW — one subclass per mode
+# because each mode re-derives the step/broadcast logic).  Here the ZeRO
+# stage lives entirely in the ENGINE (sharding strategy), so every "mode
+# optimizer" IS the base optimizer; the aliases keep the reference's import
+# surface working verbatim:  `Zero2(model, Zero2AdamW(lr=...))`.
+DDPSGD = Zero1SGD = Zero2SGD = Zero3SGD = SGD
+DDPAdamW = Zero1AdamW = Zero2AdamW = Zero3AdamW = AdamW
+
+__version__ = "0.2.0"
 
 __all__ = [
     "partition_tensors",
+    "materialize_owned",
     "SingleDevice",
     "DDP",
     "Zero1",
@@ -46,6 +56,10 @@ __all__ = [
     "init_distributed",
     "SGD",
     "AdamW",
+    "DDPSGD", "DDPAdamW",
+    "Zero1SGD", "Zero1AdamW",
+    "Zero2SGD", "Zero2AdamW",
+    "Zero3SGD", "Zero3AdamW",
     "GPTConfig",
     "GPT2Model",
     "MoEConfig",

@@ -33,32 +33,47 @@ class RuntimeAutoTuner:
         self.iters = iters
         self.verbose = verbose
         self.cache: Dict[Tuple, Callable] = {}
+        # key -> (candidates, arg signature, static kwargs): requests made
+        # from inside a trace, to be timed by resolve_pending()
+        self.pending: Dict[Tuple, Tuple] = {}
         self.frozen = False
 
     # -- key / input synthesis --------------------------------------------
 
     @staticmethod
-    def _key(candidates: Sequence[Callable], args) -> Tuple:
-        sig = tuple(
+    def _sig(args) -> Tuple:
+        return tuple(
             None if a is None else (tuple(a.shape), str(a.dtype))
             for a in args
         )
-        return (tuple(c.__module__ + "." + c.__name__ for c in candidates), sig)
+
+    @classmethod
+    def _key(cls, candidates: Sequence[Callable], args) -> Tuple:
+        return (
+            tuple(c.__module__ + "." + c.__name__ for c in candidates),
+            cls._sig(args),
+        )
 
     @staticmethod
     def _synthesize(args):
-        """Concrete stand-ins for (possibly traced) args, same shape/dtype."""
+        """Concrete stand-ins for args (arrays, or (shape, dtype) sig
+        entries from a pending record), same shape/dtype."""
         out = []
         key = jax.random.PRNGKey(0)
         for a in args:
             if a is None:
                 out.append(None)
-            elif jnp.issubdtype(a.dtype, jnp.integer):
-                out.append(jnp.zeros(a.shape, a.dtype))
+                continue
+            shape, dtype = (
+                a if isinstance(a, tuple) else (a.shape, a.dtype)
+            )
+            dtype = jnp.dtype(dtype)
+            if jnp.issubdtype(dtype, jnp.integer):
+                out.append(jnp.zeros(shape, dtype))
             else:
                 key, sub = jax.random.split(key)
-                out.append(jax.random.normal(sub, a.shape, jnp.float32)
-                           .astype(a.dtype))
+                out.append(jax.random.normal(sub, shape, jnp.float32)
+                           .astype(dtype))
         return tuple(out)
 
     def _time_one(self, fn: Callable, concrete, static_kwargs) -> float:
@@ -93,7 +108,28 @@ class RuntimeAutoTuner:
             return self.cache[key]
         if self.frozen:
             return candidates[0]
-        concrete = self._synthesize(args)
+        # `choose` usually runs INSIDE an outer jit trace (op dispatch
+        # sites).  Timing cannot happen there: plain calls stage the
+        # synthesis into the outer trace (TracerArrayConversionError),
+        # ensure_compile_time_eval evaluates candidates op-by-op eagerly
+        # (mis-timed by dispatch overhead; Pallas primitives like
+        # program_id have no eval rule), and compiling from a helper
+        # thread deadlocks against the in-progress outer trace on some
+        # backends.  So in-trace requests are RECORDED and candidate[0]
+        # returned; `resolve_pending()` times them after the trace
+        # completes, and the caller re-traces (e.g. engine.retune()) to
+        # bake the winners — same measure-then-freeze lifecycle as the
+        # reference's choose_function/final_tune split.
+        if any(isinstance(a, jax.core.Tracer)
+               for a in args if a is not None):
+            self.pending.setdefault(
+                key, (list(candidates), self._sig(args), dict(static_kwargs))
+            )
+            return candidates[0]
+        return self._pick(candidates, args, static_kwargs, key)
+
+    def _pick(self, candidates, args_or_sig, static_kwargs, key) -> Callable:
+        concrete = self._synthesize(args_or_sig)
         times = [self._time_one(c, concrete, static_kwargs)
                  for c in candidates]
         best = int(np.argmin(times))
@@ -107,6 +143,20 @@ class RuntimeAutoTuner:
             print(f"autotuner: {ranking} -> {candidates[best].__name__}")
         self.cache[key] = candidates[best]
         return candidates[best]
+
+    def resolve_pending(self) -> int:
+        """Time every request recorded during tracing (must be called OUTSIDE
+        any trace) and bake the winners into the cache.  Returns the number
+        of requests resolved; the caller then re-traces (engine.retune() /
+        a fresh jit) so the winners actually enter the compiled program."""
+        n = 0
+        for key, (candidates, sig, kw) in list(self.pending.items()):
+            del self.pending[key]
+            if key in self.cache:
+                continue
+            self._pick(candidates, sig, kw, key)
+            n += 1
+        return n
 
     # reference API name (runtime_tuner.py:16)
     choose_function = choose

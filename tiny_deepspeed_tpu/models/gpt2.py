@@ -64,6 +64,12 @@ class GPTConfig:
     # GPT-2 124M B=8: dots_no_batch ~84.0k tok/s vs nothing ~80.3k;
     # "nothing" still minimizes HBM)
     remat_policy: str = "dots_no_batch"
+    # token-embedding row-norm cap: each USED row of wte is rescaled to
+    # ||row|| <= wte_max_norm before the gather (reference nn.Embedding
+    # max_norm, wired through reference ops/embedding.py:67-68; the
+    # reference's GPT-2 never sets it, so None is parity).  Functional:
+    # the stored table is untouched, grads flow through the rescale.
+    wte_max_norm: Optional[float] = None
     # chunked lm_head+loss (never materializes full (B, T, V) logits;
     # ops/softmax_xent.fused_linear_xent).  A MEMORY knob, not a speed knob:
     # measured v5e-1 gpt2-124m B=8 T=1024 it costs ~8% (77.0k vs 83.8k
@@ -78,8 +84,13 @@ class GPTConfig:
         return self.n_embd // self.n_head
 
 
-# Named presets covering the BASELINE.md workloads.
+# Named presets covering the BASELINE.md workloads.  "tiny" exists so every
+# example entry point smoke-tests in seconds on the virtual CPU mesh
+# (`--cpu-devices 8`): XLA-CPU compile of a full 124M step takes minutes
+# (round-1 verdict weak #7); float32 compute because CPU bf16 is emulated.
 GPT2_PRESETS: Dict[str, GPTConfig] = {
+    "tiny": GPTConfig(block_size=256, vocab_size=512, n_layer=2, n_head=2,
+                      n_embd=64, compute_dtype=jnp.float32),
     "gpt2-124m": GPTConfig(n_layer=12, n_head=12, n_embd=768),
     "gpt2-350m": GPTConfig(n_layer=24, n_head=16, n_embd=1024),
     "gpt2-774m": GPTConfig(n_layer=36, n_head=20, n_embd=1280),
@@ -210,7 +221,14 @@ class GPT2Model:
                 f"sequence length {t} > block_size {c.block_size}"
             )  # reference asserts the same (model.py:142)
 
-        tok = embedding(idx, params["wte"]).astype(cd)
+        tok = embedding(idx, params["wte"])
+        if c.wte_max_norm is not None:
+            # cap the GATHERED rows, not the whole (vocab, d) table — same
+            # values (renorm is row-wise), but O(B*T*d) instead of
+            # O(vocab*d) per forward (and per remat re-forward)
+            from ..ops.embedding import renorm_weight
+            tok = renorm_weight(tok, c.wte_max_norm)
+        tok = tok.astype(cd)
         pos = params["wpe"][:t].astype(cd)
         x = tok + pos[None]
 
@@ -345,6 +363,11 @@ class GPT2Model:
         cache_key = (b, t0, max_new_tokens, temperature, top_k)
         fn = self._generate_cache.get(cache_key)
         if fn is None:
+            # bounded LRU: each entry pins a jitted executable on the model
+            # instance; unbounded growth across distinct shape/sampling
+            # combinations would leak compiled programs (ADVICE r1)
+            if len(self._generate_cache) >= 32:
+                self._generate_cache.pop(next(iter(self._generate_cache)))
             fn = jax.jit(
                 partial(
                     self._generate_impl, t0=t0,
@@ -353,6 +376,10 @@ class GPT2Model:
                 )
             )
             self._generate_cache[cache_key] = fn
+        else:
+            self._generate_cache[cache_key] = self._generate_cache.pop(
+                cache_key
+            )  # mark most-recently-used
         return fn(params, idx, key)
 
     def _generate_impl(self, params, idx, key, *, t0, max_new_tokens,

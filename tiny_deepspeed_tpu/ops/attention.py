@@ -49,17 +49,30 @@ def _sdpa_or_standard(q, k, v):
         return standard_attention(q, k, v)
 
 
+def _tuned_pallas_flash(q, k, v):
+    """Pallas flash kernel, block sizes chosen by the runtime autotuner when
+    one is installed (request recorded at trace time, winner baked on
+    retune — the real multi-candidate site the reference's tuner never had,
+    reference ops/linear.py:12 'Add more functions here').  Falls back to
+    the XLA SDPA path if the bundled kernel module is unavailable."""
+    try:
+        from .attention_pallas import FLASH_VARIANTS, pallas_flash_attention
+    except ImportError:
+        return _sdpa_or_standard(q, k, v)
+    from ..autotuner import get_default_tuner
+
+    tuner = get_default_tuner()
+    if tuner is not None:
+        return tuner.choose(FLASH_VARIANTS, (q, k, v))(q, k, v)
+    return pallas_flash_attention(q, k, v)
+
+
 def flash_attention(q, k, v):
     """Blockwise causal attention; Pallas kernel on TPU, fused XLA elsewhere."""
     # Static (trace-time) backend choice: tracers carry no device, and the
     # kernel choice must be baked into the jitted program anyway.
     if jax.default_backend() == "tpu":
-        try:
-            from .attention_pallas import pallas_flash_attention
-        except ImportError:
-            pallas_flash_attention = None
-        if pallas_flash_attention is not None:
-            return pallas_flash_attention(q, k, v)
+        return _tuned_pallas_flash(q, k, v)
     return _sdpa_or_standard(q, k, v)
 
 
@@ -109,10 +122,9 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
                 else standard_attention)(q, k, v)
 
     if impl == "flash_attention" and jax.default_backend() == "tpu":
-        from .attention_pallas import pallas_flash_attention
         spec = P(pctx.data_axis, head_axis, None, None)
         return jax.shard_map(
-            pallas_flash_attention, mesh=pctx.mesh,
+            _tuned_pallas_flash, mesh=pctx.mesh,
             in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
         )(q, k, v)
 

@@ -31,10 +31,20 @@ def softmax_cross_entropy(logits, targets):
 def _pick_chunk(t: int, want: int) -> int:
     """Largest chunk <= want that divides t; t itself when the only such
     divisor would be degenerate (< 32 rows per chunk wastes the MXU on
-    (B, tiny, V) matmuls — better to take one full-size chunk)."""
+    (B, tiny, V) matmuls — better to take one full-size chunk).  The
+    full-size fallback defeats the memory bound this op exists for, so it
+    warns (once per T — trace-time, not per step; ADVICE r1)."""
     for c in range(min(want, t), 31, -1):
         if t % c == 0:
             return c
+    if t > want:
+        import warnings
+        warnings.warn(
+            f"fused_linear_xent: sequence length {t} has no chunk divisor in "
+            f"[32, {want}]; materializing full (B, {t}, V) logits — pad T to "
+            "a multiple of a power of two to keep the chunked path",
+            stacklevel=3,
+        )
     return t
 
 

@@ -28,7 +28,7 @@ from .base import Optimizer
 class AdamW(Optimizer):
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=1e-2, amsgrad=False, maximize=False,
-                 decoupled=False, fused=False):
+                 decoupled=False, fused=False, state_dtype=jnp.float32):
         """fused: True/"auto" uses the Pallas one-VMEM-pass update kernel
         (optim/adamw_pallas.py; "auto" restricts it to single-device TPU,
         True forces it on single-device TPU/interpret); False (default) uses
@@ -38,7 +38,12 @@ class AdamW(Optimizer):
         custom-call boundary costs more than the kernel saves on a purely
         bandwidth-bound op.  Multi-device always uses XLA — a Pallas custom
         call cannot be GSPMD-partitioned, so on ZeRO-sharded state it would
-        force an all-gather."""
+        force an all-gather.
+
+        state_dtype: storage dtype for the m/v (and vmax) slots.  Update math
+        always runs in float32; bfloat16 storage halves optimizer-state HBM
+        (the knob that lets GPT-2 1.5B + AdamW fit a single 16 GB v5e chip,
+        BASELINE.md) at the cost of quantized moment carries."""
         super().__init__(lr)
         self.b1, self.b2 = betas
         self.eps = eps
@@ -47,25 +52,53 @@ class AdamW(Optimizer):
         self.maximize = maximize
         self.decoupled = decoupled
         self.fused = fused
+        self.state_dtype = state_dtype
 
     def _use_fused(self, param) -> bool:
-        if self.fused is False or self.amsgrad:
+        if self.fused is False:
+            return False
+        if self.amsgrad:
+            self._warn_unfused("amsgrad has no Pallas kernel")
+            return False
+        if self.state_dtype != jnp.float32:
+            self._warn_unfused("state_dtype != float32")
             return False
         import jax
 
         from .adamw_pallas import INTERPRET, pallas_supported
         if not pallas_supported(param):
+            self._warn_unfused(
+                f"leaf {tuple(param.shape)} {param.dtype} unsupported "
+                "(non-f32 or too small)"
+            )
             return False
         # multi-device ALWAYS refuses (even fused=True): the custom call
         # cannot be GSPMD-partitioned, so sharded state would all-gather
         if jax.device_count() != 1:
+            self._warn_unfused("multi-device (custom call is not "
+                               "GSPMD-partitionable)")
             return False
         # the kernel only lowers via Mosaic (TPU) or interpret mode; other
         # backends fall back to XLA for both "auto" and True
-        return jax.default_backend() == "tpu" or INTERPRET
+        ok = jax.default_backend() == "tpu" or INTERPRET
+        if not ok:
+            self._warn_unfused(f"backend {jax.default_backend()!r} cannot "
+                               "lower the Mosaic kernel")
+        return ok
+
+    def _warn_unfused(self, why: str) -> None:
+        """fused=True explicitly requested but not honorable: say so once
+        (fused="auto" keeps the silent fallback — ADVICE r1)."""
+        if self.fused is True and not getattr(self, "_warned_unfused", False):
+            import warnings
+            warnings.warn(
+                f"AdamW(fused=True) falling back to the XLA update: {why}",
+                stacklevel=3,
+            )
+            self._warned_unfused = True
 
     def init_one(self, name, param):
-        z = jnp.zeros(param.shape, jnp.float32)
+        z = jnp.zeros(param.shape, self.state_dtype)
         state = {"m": z, "v": z}
         if self.amsgrad:
             state["vmax"] = z
@@ -73,33 +106,85 @@ class AdamW(Optimizer):
 
     def update_one(self, name, param, grad, state, step):
         if self._use_fused(param):
-            from .adamw_pallas import adamw_update_pallas
-            new_p, m, v = adamw_update_pallas(
-                param, grad, state["m"], state["v"], step,
-                lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
-                wd=self.weight_decay, decoupled=self.decoupled,
-                maximize=self.maximize,
+            kw = dict(lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
+                      wd=self.weight_decay, decoupled=self.decoupled,
+                      maximize=self.maximize)
+            impl = _pallas_update
+            if self.fused == "auto":
+                # route the kernel-vs-XLA decision through the runtime
+                # tuner per (shape, dtype) when one is installed — the
+                # measured end-to-end winner is usually XLA's in-graph
+                # fusion (docstring above), but the tradeoff is shape-
+                # dependent; fused=True still forces the kernel.
+                from ..autotuner import get_default_tuner
+                tuner = get_default_tuner()
+                if tuner is not None:
+                    impl = tuner.choose(
+                        [_pallas_update, _xla_update],
+                        (param, grad, state["m"], state["v"], step), **kw
+                    )
+            new_p, m, v = impl(
+                param, grad, state["m"], state["v"], step, **kw
             )
             return new_p, {"m": m, "v": v}
+        kw = dict(lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
+                  wd=self.weight_decay, decoupled=self.decoupled,
+                  maximize=self.maximize)
+        sd = self.state_dtype
+        if not self.amsgrad:
+            new_p, m, v = _xla_update(
+                param, grad, state["m"].astype(jnp.float32),
+                state["v"].astype(jnp.float32), step, **kw
+            )
+            return new_p, {"m": m.astype(sd), "v": v.astype(sd)}
+        # amsgrad keeps its own tail (vmax has no fused/candidate form)
         g = grad.astype(jnp.float32)
         p = param.astype(jnp.float32)
         if self.maximize:
             g = -g
         if self.weight_decay and not self.decoupled:
             g = g + self.weight_decay * p  # reference adamw.py:37-38
-        m = self.b1 * state["m"] + (1.0 - self.b1) * g
-        v = self.b2 * state["v"] + (1.0 - self.b2) * jnp.square(g)
+        m = self.b1 * state["m"].astype(jnp.float32) + (1.0 - self.b1) * g
+        v = (self.b2 * state["v"].astype(jnp.float32)
+             + (1.0 - self.b2) * jnp.square(g))
         t = step.astype(jnp.float32)
         mhat = m / (1.0 - jnp.power(self.b1, t))
-        if self.amsgrad:
-            vmax = jnp.maximum(state["vmax"], v)
-            vhat = vmax / (1.0 - jnp.power(self.b2, t))
-            new_state = {"m": m, "v": v, "vmax": vmax}
-        else:
-            vhat = v / (1.0 - jnp.power(self.b2, t))
-            new_state = {"m": m, "v": v}
+        vmax = jnp.maximum(state["vmax"].astype(jnp.float32), v)
+        vhat = vmax / (1.0 - jnp.power(self.b2, t))
+        new_state = {"m": m.astype(sd), "v": v.astype(sd),
+                     "vmax": vmax.astype(sd)}
         upd = mhat / (jnp.sqrt(vhat) + self.eps)
         if self.weight_decay and self.decoupled:
             upd = upd + self.weight_decay * p
         new_p = p - self.lr * upd
         return new_p.astype(param.dtype), new_state
+
+
+# -- tuner candidates (f32 state, no amsgrad) --------------------------------
+
+def _pallas_update(param, grad, m, v, step, *, lr, b1, b2, eps, wd,
+                   decoupled, maximize):
+    from .adamw_pallas import adamw_update_pallas
+    return adamw_update_pallas(
+        param, grad, m, v, step, lr=lr, b1=b1, b2=b2, eps=eps, wd=wd,
+        decoupled=decoupled, maximize=maximize,
+    )
+
+
+def _xla_update(param, grad, m, v, step, *, lr, b1, b2, eps, wd,
+                decoupled, maximize):
+    g = grad.astype(jnp.float32)
+    p = param.astype(jnp.float32)
+    if maximize:
+        g = -g
+    if wd and not decoupled:
+        g = g + wd * p
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * jnp.square(g)
+    t = step.astype(jnp.float32)
+    upd = (m / (1.0 - jnp.power(b1, t))) / (
+        jnp.sqrt(v / (1.0 - jnp.power(b2, t))) + eps
+    )
+    if wd and decoupled:
+        upd = upd + wd * p
+    return (p - lr * upd).astype(param.dtype), m, v

@@ -88,7 +88,10 @@ def _n_granules(devices) -> Tuple[int, str]:
 
     Granules must be equal-sized for a hybrid layout (mesh_utils builds one
     ICI mesh per granule); uneven subsets report 1 so callers fall back to
-    the flat reshape."""
+    the flat reshape.  A UNIFORM slice_index means all devices share one ICI
+    domain — report 1 granule immediately rather than falling through to
+    process_index, which would wrongly treat ICI-connected hosts of a
+    single-slice pod as DCN granules (ADVICE r1)."""
     from collections import Counter
 
     for attr in ("slice_index", "process_index"):
@@ -96,6 +99,8 @@ def _n_granules(devices) -> Tuple[int, str]:
             counts = Counter(getattr(d, attr) for d in devices)
             if len(counts) > 1 and len(set(counts.values())) == 1:
                 return len(counts), attr
+            if attr == "slice_index" and len(counts) == 1:
+                return 1, ""
     return 1, ""
 
 
@@ -112,10 +117,16 @@ def _device_grid(shape, axis_names, devices) -> np.ndarray:
         dcn = [1] * len(shape)
         ici[data_ix] = shape[data_ix] // n_gran
         dcn[data_ix] = n_gran
-        return mesh_utils.create_hybrid_device_mesh(
-            ici, dcn, devices,
-            process_is_granule=(attr == "process_index"),
-        )
+        try:
+            return mesh_utils.create_hybrid_device_mesh(
+                ici, dcn, devices,
+                process_is_granule=(attr == "process_index"),
+            )
+        except Exception:
+            # some topologies cannot realize the per-granule ICI shape;
+            # a flat reshape still yields a working (if suboptimal) mesh
+            # rather than failing mesh construction outright (ADVICE r1)
+            pass
     return np.asarray(devices).reshape(shape)
 
 

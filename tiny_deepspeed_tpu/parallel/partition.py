@@ -12,9 +12,12 @@ TPU equivalent of the reference's meta-device trick is `jax.eval_shape`
 Semantic note (SURVEY §7 hard-part 1): the reference uses this table as the
 *physical* layout — whole tensors live on one rank (MPMD-flavored).  The TPU
 engines instead lay tensors out with even axis-sharding (SPMD, NamedSharding)
-and keep this table as the API-parity ownership/report surface; both are
-exposed.  The table is also honored physically by the optimizer's
-owner-masked step in tests that check reference-equivalent semantics.
+and keep this table as the API-parity ownership/report surface.  The
+reference's physical mode (`malloc=...`, reference partition.py:87-93 —
+materialize each whole tensor on its owner) is available separately as
+`materialize_owned` below; the ZeRO engines do not use it (even axis-sharding
+is the TPU-correct layout), it exists for host-side staging and for users of
+the reference's placement semantics.
 """
 
 from __future__ import annotations
@@ -107,3 +110,36 @@ def partition_sizes(table: Dict[str, int], named_tensors, num_parts: int):
     for name, t in src:
         sizes[table[name]] += _numel(t)
     return sizes
+
+
+def materialize_owned(named_shapes, table: Dict[str, int], devices=None,
+                      init=None):
+    """Physically place each WHOLE tensor on its owner rank's device — the
+    reference's `malloc` mode (reference zero/utils/partition.py:87-93:
+    materialize the partition on the target device instead of meta).
+
+    The SPMD ZeRO engines never call this (they shard every tensor evenly
+    across the data axis); it exists for reference-placement semantics:
+    host-side staging, per-owner export, or MPMD-style tooling.
+
+    Args:
+      named_shapes: dict name -> array or ShapeDtypeStruct.
+      table: {name: owner part index} from partition_tensors.
+      devices: sequence indexed by part id (default jax.devices()).
+      init: optional callable (name, shape_struct) -> jax.Array; default
+        zeros.
+    Returns {name: jax.Array living only on devices[table[name]]}.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    devices = list(devices) if devices is not None else jax.devices()
+    out = {}
+    for name, s in named_shapes.items():
+        dev = devices[table[name] % len(devices)]
+        if init is not None:
+            val = init(name, s)
+        else:
+            val = jnp.zeros(s.shape, s.dtype)
+        out[name] = jax.device_put(val, dev)
+    return out
