@@ -155,22 +155,105 @@ def test_pipeline_rejects_bad_shapes():
     model = GPT2Model(cfg)
     with pytest.raises(ValueError, match="n_layer"):
         DDP(model, AdamW(lr=1e-3), pipeline_parallel=4)
-    with pytest.raises(ValueError, match="seq_parallel"):
-        DDP(GPT2Model(tiny_cfg()), AdamW(lr=1e-3), pipeline_parallel=2,
-            seq_parallel=2)
-    # explicit mesh with both axes bypasses the kwarg guard; resolved-axis
-    # guard must still catch it
-    with pytest.raises(ValueError, match="unsupported"):
-        DDP(GPT2Model(tiny_cfg()), AdamW(lr=1e-3),
-            mesh=make_mesh((2, 2, 2), ("data", "seq", "pipe")))
 
 
 def test_pipeline_rejects_incapable_model():
     """Models whose apply() has no pipeline path must be rejected, not
     silently run un-pipelined with the layer axis sharded."""
-    from tiny_deepspeed_tpu import MoEConfig, MoEGPT
-    moe = MoEGPT(MoEConfig(block_size=64, vocab_size=128, n_layer=2,
-                           n_head=2, n_embd=32, n_expert=2,
-                           compute_dtype=jnp.float32))
+    class NoPipe(GPT2Model):
+        pipeline_capable = False
+
     with pytest.raises(ValueError, match="pipeline_capable"):
-        DDP(moe, AdamW(lr=1e-3), pipeline_parallel=2)
+        DDP(NoPipe(tiny_cfg()), AdamW(lr=1e-3), pipeline_parallel=2)
+
+
+def test_microbatch_sweep_matches_scan():
+    """Bubble amortization knob: every microbatch count M gives the same
+    numerics; utilization M/(M+S-1) varies, results must not (round-1
+    verdict #8's sweep)."""
+    mesh = make_mesh((2, 4), ("data", "pipe"))
+    l, d, b = 4, 16, 8
+    w = jax.random.normal(jax.random.PRNGKey(0), (l, d, d), jnp.float32) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, 4, d), jnp.float32)
+
+    def block(c, wl):
+        return c + jnp.tanh(c @ wl)
+
+    def seq(w, x):
+        return jax.lax.scan(lambda c, wl: (block(c, wl), None), x, w)[0]
+
+    ref = np.asarray(seq(w, x))
+    for m in (4, 8, 2, 1):
+        if b % m:
+            continue
+        got = jax.jit(lambda w, x, m=m: spmd_pipeline(
+            block, w, x, mesh=mesh, microbatches=m
+        ))(w, x)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5,
+                                   atol=1e-5, err_msg=f"microbatches={m}")
+
+
+def test_pipeline_composes_with_seq_parallel():
+    """pipeline v2: dp=2 x seq=2 x pipe=2 — ring attention runs inside the
+    pipeline's manual region; loss matches single-device."""
+    cfg = tiny_cfg()
+    model = GPT2Model(cfg)
+    idx, tgt = batch(cfg)
+
+    ref_engine = SingleDevice(model, AdamW(lr=1e-3))
+    ref_state = ref_engine.init(jax.random.PRNGKey(0))
+    eng = Zero2(model, AdamW(lr=1e-3), seq_parallel=2, pipeline_parallel=2)
+    assert eng.mesh.shape == {"data": 2, "seq": 2, "pipe": 2}
+    state = eng.init(jax.random.PRNGKey(0))
+
+    for _ in range(3):
+        ref_state, ref_loss = ref_engine.step(ref_state, (idx, tgt))
+        state, loss = eng.step(state, (idx, tgt))
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_moe_pipeline_capable():
+    """pipeline v2: MoE runs under pipe=2 (aux loss threaded through the
+    pipeline, bubble ticks masked) and tracks the un-pipelined loss."""
+    from tiny_deepspeed_tpu import MoEConfig, MoEGPT
+    cfg = MoEConfig(block_size=64, vocab_size=128, n_layer=2, n_head=2,
+                    n_embd=32, n_expert=2, capacity_factor=2.0,
+                    compute_dtype=jnp.float32)
+    moe = MoEGPT(cfg)
+    idx, tgt = batch(cfg)
+
+    ref_engine = SingleDevice(moe, AdamW(lr=1e-3))
+    ref_state = ref_engine.init(jax.random.PRNGKey(0))
+    eng = Zero1(moe, AdamW(lr=1e-3), pipeline_parallel=2,
+                tensor_parallel=2)
+    state = eng.init(jax.random.PRNGKey(0))
+
+    ref_state, ref_loss = ref_engine.step(ref_state, (idx, tgt))
+    state, loss = eng.step(state, (idx, tgt))
+    # aux is computed per microbatch (capacity truncation differs from the
+    # full-batch route) — identical LM loss + small aux-term wiggle
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_moe_pipeline_with_seq_parallel():
+    """MoE under dp=2 x seq=2 x pipe=2: aux is pmean'd over seq shards (each
+    routes its own token slice) so the replicated out_spec is honest; loss
+    tracks single-device within routing tolerance."""
+    from tiny_deepspeed_tpu import MoEConfig, MoEGPT
+    cfg = MoEConfig(block_size=64, vocab_size=128, n_layer=2, n_head=2,
+                    n_embd=32, n_expert=2, capacity_factor=2.0,
+                    compute_dtype=jnp.float32)
+    moe = MoEGPT(cfg)
+    idx, tgt = batch(cfg)
+
+    ref_engine = SingleDevice(moe, AdamW(lr=1e-3))
+    ref_state = ref_engine.init(jax.random.PRNGKey(0))
+    eng = Zero1(moe, AdamW(lr=1e-3), seq_parallel=2, pipeline_parallel=2)
+    state = eng.init(jax.random.PRNGKey(0))
+
+    ref_state, ref_loss = ref_engine.step(ref_state, (idx, tgt))
+    state, loss = eng.step(state, (idx, tgt))
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=2e-2, atol=2e-2)

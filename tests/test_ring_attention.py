@@ -41,6 +41,48 @@ class TestRingAttention:
             rtol=1e-5, atol=1e-5,
         )
 
+    def test_memory_o_t_over_n(self):
+        """The headline long-context claim, proven on the compiled program
+        (round-1 verdict #10): per-device temp memory of ring attention at
+        T=4096 on the 8-way seq mesh is a small fraction of the all-gather
+        formulation's — full K/V and the (T/n, T) score slab never
+        materialize; the ring holds only (T/n, T/n) blocks."""
+        mesh = make_mesh(axis_names=("seq",))
+        b, h, t, d = 1, 4, 4096, 64
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32)
+                   for kk in ks)
+        spec = jax.sharding.PartitionSpec(None, None, "seq", None)
+
+        def gathered(ql, kl, vl):
+            # what GSPMD does without the ring: all-gather K/V, then the
+            # (T/n, T) score slab (unmasked — we only compile for memory,
+            # never compare values)
+            kg = jax.lax.all_gather(kl, "seq", axis=2, tiled=True)
+            vg = jax.lax.all_gather(vl, "seq", axis=2, tiled=True)
+            s = jnp.einsum("bhqd,bhkd->bhqk", ql, kg,
+                           preferred_element_type=jnp.float32)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, vg)
+
+        def temp_bytes(fn):
+            sm = jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                               out_specs=spec, check_vma=False)
+            c = jax.jit(sm).lower(q, k, v).compile()
+            return c.memory_analysis().temp_size_in_bytes
+
+        from tiny_deepspeed_tpu.parallel.ring_attention import (
+            ring_attention_local,
+        )
+        import functools
+        ring = functools.partial(
+            ring_attention_local, axis_name="seq", axis_size=8
+        )
+        ring_b, gath_b = temp_bytes(ring), temp_bytes(gathered)
+        # scores alone: gathered (T/n, T) vs ring (T/n, T/n) => ~n x gap;
+        # assert a conservative 2.5x
+        assert ring_b * 2.5 < gath_b, (ring_b, gath_b)
+
     def test_grads_flow(self):
         mesh = make_mesh(axis_names=("seq",))
         q, k, v = qkv()

@@ -317,6 +317,7 @@ class GPT2Model:
                 mesh=pctx.mesh, pipe_axis=pctx.pipe_axis,
                 data_axis=pctx.data_axis,
                 microbatches=pctx.pipe_microbatches or None,
+                seq_axis=pctx.seq_axis,
             )
         else:
             def scan_body(x, bp):

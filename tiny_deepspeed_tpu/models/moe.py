@@ -47,9 +47,9 @@ class MoEConfig(GPTConfig):
 class MoEGPT(GPT2Model):
     """GPT-2 skeleton with MoE MLPs.  Same functional API as GPT2Model."""
 
-    # apply() below carries the aux load-balance loss through a plain scan;
-    # it has no GPipe path, so the engine must reject pipeline_parallel>1
-    pipeline_capable = False
+    # apply() carries the aux load-balance loss through the scan AND through
+    # the GPipe pipeline (spmd_pipeline with_aux: bubble ticks masked)
+    pipeline_capable = True
 
     def __init__(self, config: MoEConfig):
         super().__init__(config)
@@ -215,17 +215,35 @@ class MoEGPT(GPT2Model):
         x = self.embed(params, idx, pctx)
         stacked = self.stacked_compute_params(params)
 
-        def block(carry, bp):
-            x, aux_sum = carry
-            x, aux = self._block(x, bp, pctx)
-            return (x, aux_sum + aux), None
+        if pctx is not None and pctx.pipe_parallel:
+            from ..parallel.pipeline import spmd_pipeline
 
-        if c.remat:
-            block = jax.checkpoint(block, policy=self.remat_policy())
+            def block_aux(x, bp):
+                return self._block(x, bp, pctx)  # -> (x, aux)
 
-        (x, aux_sum), _ = jax.lax.scan(
-            block, (x, jnp.zeros((), jnp.float32)), stacked
-        )
+            if c.remat:
+                block_aux = jax.checkpoint(
+                    block_aux, policy=self.remat_policy()
+                )
+            x, aux_sum = spmd_pipeline(
+                block_aux, stacked, x,
+                mesh=pctx.mesh, pipe_axis=pctx.pipe_axis,
+                data_axis=pctx.data_axis,
+                microbatches=pctx.pipe_microbatches or None,
+                seq_axis=pctx.seq_axis, with_aux=True,
+            )
+        else:
+            def block(carry, bp):
+                x, aux_sum = carry
+                x, aux = self._block(x, bp, pctx)
+                return (x, aux_sum + aux), None
+
+            if c.remat:
+                block = jax.checkpoint(block, policy=self.remat_policy())
+
+            (x, aux_sum), _ = jax.lax.scan(
+                block, (x, jnp.zeros((), jnp.float32)), stacked
+            )
 
         out = self.head(params, x, targets, pctx, position)
         if targets is not None:

@@ -101,6 +101,16 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
     head_axis = pctx.model_axis if pctx.tensor_parallel else None
 
     if pctx.seq_parallel:
+        if pctx.pipe_parallel:
+            # inside the pipeline's shard_map, which is manual over BOTH
+            # {pipe, seq} (parallel/pipeline.py): q/k/v are already local
+            # (T/n) shards and the seq axis is manual, so the ring body is
+            # called directly — wrapping another shard_map would fail
+            from ..parallel.ring_attention import ring_attention_local
+            return ring_attention_local(
+                q, k, v, axis_name=pctx.seq_axis,
+                axis_size=pctx.mesh.shape[pctx.seq_axis],
+            )
         return ring_attention(
             q, k, v, pctx.mesh, seq_axis=pctx.seq_axis,
             batch_axis=pctx.data_axis, head_axis=head_axis,

@@ -105,10 +105,10 @@ class AdamW(Optimizer):
         return state
 
     def update_one(self, name, param, grad, state, step):
+        kw = dict(lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
+                  wd=self.weight_decay, decoupled=self.decoupled,
+                  maximize=self.maximize)
         if self._use_fused(param):
-            kw = dict(lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
-                      wd=self.weight_decay, decoupled=self.decoupled,
-                      maximize=self.maximize)
             impl = _pallas_update
             if self.fused == "auto":
                 # route the kernel-vs-XLA decision through the runtime
@@ -127,9 +127,6 @@ class AdamW(Optimizer):
                 param, grad, state["m"], state["v"], step, **kw
             )
             return new_p, {"m": m, "v": v}
-        kw = dict(lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
-                  wd=self.weight_decay, decoupled=self.decoupled,
-                  maximize=self.maximize)
         sd = self.state_dtype
         if not self.amsgrad:
             new_p, m, v = _xla_update(

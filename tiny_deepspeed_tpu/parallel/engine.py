@@ -198,12 +198,6 @@ class ZeroEngine:
         self.model = model
         self.optimizer = optimizer
         pp = int(pipeline_parallel)
-        if pp > 1 and int(seq_parallel) > 1:
-            raise ValueError(
-                "pipeline_parallel does not compose with seq_parallel yet "
-                "(ring attention's shard_map cannot nest inside the "
-                "pipeline's manual region)"
-            )
         if mesh is None:
             if not self.data_parallel:
                 mesh = make_mesh(devices=[jax.devices()[0]])
@@ -239,14 +233,9 @@ class ZeroEngine:
         self.model_axis = _axis("model")
         self.expert_axis = _axis("expert")
         self.pipe_axis = _axis("pipe")
-        # re-check on the RESOLVED axes: an explicit mesh with both "seq"
-        # and "pipe" axes bypasses the kwarg guard above
-        if self.seq_axis is not None and self.pipe_axis is not None:
-            raise ValueError(
-                "a mesh with both 'seq' and 'pipe' axes is unsupported "
-                "(ring attention's shard_map cannot nest inside the "
-                "pipeline's manual region)"
-            )
+        # seq x pipe composes since pipeline v2: the pipeline's shard_map
+        # goes manual over {pipe, seq} and ring attention runs inside it
+        # (parallel/pipeline.py seq_axis, ops/attention.py dispatch)
         if self.pipe_axis is not None and not getattr(
             model, "pipeline_capable", False
         ):
@@ -340,6 +329,7 @@ class ZeroEngine:
             batch_spec = P(None, *batch_spec)
         self._batch_sharding = NamedSharding(mesh, batch_spec)
 
+        self._retuned = False
         self._build_step()
 
     def _build_step(self) -> None:
@@ -376,11 +366,15 @@ class ZeroEngine:
         """
         from ..autotuner import get_default_tuner
         tuner = get_default_tuner()
-        if tuner is None or not tuner.pending:
+        if tuner is None:
             return 0
         n = tuner.resolve_pending()
-        if n:
+        # rebuild also when another engine sharing the tuner already resolved
+        # our pending keys (n == 0 but winners sit in the cache and this
+        # engine's compiled step still runs candidate[0])
+        if n or (tuner.cache and not self._retuned):
             self._build_step()
+            self._retuned = True
         return n
 
     # -- state creation ----------------------------------------------------

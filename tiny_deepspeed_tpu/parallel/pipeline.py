@@ -45,16 +45,28 @@ def spmd_pipeline(
     pipe_axis: str = "pipe",
     data_axis: Optional[str] = "data",
     microbatches: Optional[int] = None,
+    seq_axis: Optional[str] = None,
+    with_aux: bool = False,
 ):
     """Run `x` through the layer-stacked `stacked` params as an S-stage
     GPipe pipeline over `pipe_axis`.
 
-    block_fn: (x, block_params) -> x, one transformer block.
+    block_fn: (x, block_params) -> x, one transformer block — or
+              (x, block_params) -> (x, aux scalar) when `with_aux` (MoE
+              load-balance loss; aux from pipeline-bubble ticks is masked
+              out and the real ticks' aux sums across layers/microbatches/
+              stages).
     stacked:  pytree of (n_layer, ...) tensors, n_layer % S == 0; leading
               axis sharded over `pipe_axis` (each stage holds its slab).
     x:        (B, T, D) activations, B % microbatches == 0.
-    Returns (B, T, D), numerically identical to `lax.scan(block_fn, x,
-    stacked)` (tested in tests/test_pipeline.py).
+    seq_axis: when sequence/context parallelism is active, the mesh axis T
+              is sharded over.  The shard_map then goes manual over BOTH
+              {pipe, seq} so ring attention's ppermute ring (which needs a
+              manual seq axis) runs INSIDE the pipeline body — the
+              composition round 1 ruled out is expressed by widening the
+              manual set instead of nesting shard_maps.
+    Returns (B, T, D) — or ((B, T, D), aux) with `with_aux` — numerically
+    identical to `lax.scan(block_fn, x, stacked)` (tests/test_pipeline.py).
     """
     s = mesh.shape[pipe_axis]
     m = int(microbatches) if microbatches else s
@@ -67,7 +79,16 @@ def spmd_pipeline(
         raise ValueError(f"batch {b} not divisible by microbatches {m}")
     if s == 1:
         def body(c, bp):
+            if with_aux:
+                xc, aux = c
+                xn, a = block_fn(xc, bp)
+                return (xn, aux + a), None
             return block_fn(c, bp), None
+        if with_aux:
+            (y, aux), _ = jax.lax.scan(
+                body, (x, jnp.zeros((), jnp.float32)), stacked
+            )
+            return y, aux
         return jax.lax.scan(body, x, stacked)[0]
 
     # Microbatch split OUTSIDE the shard_map: the M axis must be replicated
@@ -82,48 +103,88 @@ def spmd_pipeline(
     boundary_dtype = (
         jnp.float32 if jax.default_backend() == "cpu" else dtype
     )
+    sp = seq_axis if (seq_axis is not None
+                      and seq_axis in mesh.axis_names
+                      and mesh.shape[seq_axis] > 1) else None
     xmb = x.reshape(m, b // m, *x.shape[1:]).astype(boundary_dtype)
     if data_axis is not None and data_axis in mesh.axis_names:
         xmb = jax.lax.with_sharding_constraint(
-            xmb, NamedSharding(mesh, P(None, data_axis))
+            xmb, NamedSharding(mesh, P(None, data_axis, sp))
         )
 
     def local(stacked_loc, xmb):
         xmb = xmb.astype(dtype)
         stage = jax.lax.axis_index(pipe_axis)
         state = jnp.zeros(xmb.shape[1:], xmb.dtype)
+        aux0 = jnp.zeros((), jnp.float32)
         shift = [(i, i + 1) for i in range(s - 1)]  # no wrap: stage 0 injects
 
-        def tick(state, t):
+        def tick(carry, t):
+            state, aux_acc = carry
             inj = jax.lax.dynamic_index_in_dim(
                 xmb, jnp.clip(t, 0, m - 1), 0, keepdims=False
             )
             state = jnp.where(stage == 0, inj, state)
 
             def layer(c, bp):
+                if with_aux:
+                    xc, a = c
+                    xn, anew = block_fn(xc, bp)
+                    return (xn, a + anew), None
                 return block_fn(c, bp), None
 
-            state, _ = jax.lax.scan(layer, state, stacked_loc)
+            if with_aux:
+                (state, aux_tick), _ = jax.lax.scan(
+                    layer, (state, jnp.zeros((), jnp.float32)), stacked_loc
+                )
+                # this stage holds microbatch j = t - stage; bubble ticks
+                # (j outside [0, m)) process zeros — their aux is noise
+                j = t - stage
+                aux_acc = aux_acc + jnp.where(
+                    (j >= 0) & (j < m), aux_tick, 0.0
+                )
+            else:
+                state, _ = jax.lax.scan(layer, state, stacked_loc)
             out = state
             state = jax.lax.ppermute(state, pipe_axis, shift)
-            return state, out
+            return (state, aux_acc), out
 
-        _, outs = jax.lax.scan(tick, state, jnp.arange(m + s - 1))
+        (_, aux_loc), outs = jax.lax.scan(
+            tick, (state, aux0), jnp.arange(m + s - 1)
+        )
         # microbatch j leaves the last stage at tick j + s - 1
         y = outs[s - 1 : s - 1 + m]
         # only the last stage's copy is the real output; psum broadcasts it
         # (in boundary_dtype — see the CPU AllReducePromotion note above)
         y = jnp.where(stage == s - 1, y.astype(boundary_dtype),
                       jnp.zeros(y.shape, boundary_dtype))
-        return jax.lax.psum(y, pipe_axis)
+        y = jax.lax.psum(y, pipe_axis)
+        if with_aux:
+            # mean over microbatches: each tick's aux is a token-mean over
+            # one microbatch, so the sum over m microbatches is ~m x the
+            # full-batch value the plain scan computes
+            aux = jax.lax.psum(aux_loc, pipe_axis) / m
+            if sp:
+                # seq shards each routed their own T/n token slice: average
+                # the per-shard estimates so the P() out_spec's replication
+                # claim is actually true (a bare pipe-psum would return one
+                # arbitrary seq shard's value)
+                aux = jax.lax.pmean(aux, sp)
+            return y, aux
+        return y
 
     specs = jax.tree.map(lambda _: P(pipe_axis), stacked)
-    y = jax.shard_map(
+    manual = {pipe_axis} | ({sp} if sp else set())
+    x_spec = P(None, None, sp) if sp else P()
+    out_spec = (x_spec, P()) if with_aux else x_spec
+    res = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(specs, P()),
-        out_specs=P(),
-        axis_names={pipe_axis},
+        in_specs=(specs, x_spec),
+        out_specs=out_spec,
+        axis_names=manual,
         check_vma=False,
     )(stacked, xmb)
-    return y.reshape(b, *x.shape[1:]).astype(dtype)
+    y, aux = res if with_aux else (res, None)
+    y = y.reshape(b, *x.shape[1:]).astype(dtype)
+    return (y, aux) if with_aux else y
