@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Shared harness for the five train entry points.
 
 Parity with the reference example scripts (example/{single_device,ddp,zero1,

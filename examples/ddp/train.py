@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """DDP: replicated params, sharded batch, all-reduced grads (parity: reference example/ddp/train.py:15-37)."""
 
 import os

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Pipeline parallelism: GPipe microbatch pipeline over a "pipe" mesh axis.
 
 No reference counterpart (the reference's parallelism surface is DP +

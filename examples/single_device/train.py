@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Single-device GPT-2 training (parity: reference example/single_device/train.py:14-28)."""
 
 import os

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """ZeRO-1: sharded optimizer state (parity: reference example/zero1/train.py:16-46)."""
 
 import os

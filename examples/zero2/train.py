@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """ZeRO-2: sharded optimizer state + gradients (parity: reference example/zero2/train.py:16-46)."""
 
 import os

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """ZeRO-3: fully sharded params/grads/optimizer (parity: reference example/zero3/train.py:16-46 - completed here; the reference's is broken, SURVEY 2.18)."""
 
 import os

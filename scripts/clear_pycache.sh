@@ -1,0 +1,8 @@
+#!/bin/sh
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+# Repo hygiene: remove python bytecode caches (reference script/clear-pycache.sh).
+find "$(dirname "$0")/.." -type d -name __pycache__ -prune -exec rm -rf {} + 2>/dev/null
+find "$(dirname "$0")/.." -type f -name '*.pyc' -delete 2>/dev/null
+echo "pycache cleared"

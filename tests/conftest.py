@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Test bootstrap: 8 virtual CPU devices before JAX initializes.
 
 The reference has NO test suite at all (SURVEY §4) — its de-facto tests are

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """RuntimeAutoTuner: caching, freezing, fallback on failing candidates."""
 
 import jax

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Checkpoint/resume: sharded save + restore into engine shardings; training
 continues bit-exact after resume."""
 

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Native data pipeline: build, both modes, shift correctness, determinism,
 sustained prefetch, and NumPy-fallback equivalence of semantics."""
 

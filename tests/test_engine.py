@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Engine tests on the 8-device CPU mesh: every ZeRO stage trains and all
 stages produce the SAME loss trajectory as single-device for the same global
 batch (the numerical-equivalence criterion SURVEY §4 calls for — and a

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Every entry point runs end-to-end on the virtual CPU mesh in seconds.
 
 The reference's de-facto test suite is "run the five train scripts under

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Mesh construction: hybrid ICI x DCN layout for multi-slice topologies.
 
 No pod is available in CI; the DCN-aware device-grid logic is exercised with

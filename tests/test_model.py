@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """GPT-2 model tests: shapes, loss sanity, determinism, attention switch."""
 
 import jax

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """MoE GPT + expert parallelism on the 8-device CPU mesh.
 
 The reference has no MoE / expert parallelism (SURVEY §2.20).  Acceptance:

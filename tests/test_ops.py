@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Op-layer numerics: forward values and custom_vjp grads vs autodiff/closed form.
 
 The reference validates grads only via runtime shape asserts in backward

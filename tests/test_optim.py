@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Optimizer semantics vs torch CPU reference (torch is in the image).
 
 The reference's optimizers are torch-semantics (core/optim/sgd.py, adamw.py);

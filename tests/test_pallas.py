@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Pallas kernel numerics, run in interpret mode on the CPU CI mesh.
 
 On real TPU the same kernels are exercised by bench.py and the examples; this

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Property tests for the cache rank map (partition_tensors).
 
 The reference's only check is a printing __main__ self-test

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Pipeline parallelism: GPipe ppermute pipeline == sequential layer scan.
 
 The reference has no pipeline parallelism (SURVEY §2.20); these tests hold

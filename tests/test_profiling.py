@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Profiling/metrics subsystem: timer, comm report, JSONL metrics."""
 
 import json

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Ring attention + sequence-parallel engine on the 8-device CPU mesh."""
 
 import jax

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Tensor parallelism (Megatron-style "model" mesh axis) on the 8-device CPU
 mesh.  TP is a capability the reference lacks entirely (SURVEY §2.20: the
 parallelism surface is DP + ZeRO only); here it composes with every ZeRO

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Tiny-DeepSpeed-TPU: a TPU-native re-design of Tiny-DeepSpeed's ZeRO stack.
 
 A brand-new framework (JAX / XLA / pjit / Pallas) providing the capabilities of

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Runtime autotuner (parity: reference core/autotuner/__init__.py:3)."""
 
 from .runtime_tuner import RuntimeAutoTuner, get_default_tuner, set_default_tuner

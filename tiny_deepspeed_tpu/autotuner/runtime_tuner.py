@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """RuntimeAutoTuner: measure candidate kernels, cache the winner per shape.
 
 Capability parity with reference core/autotuner/runtime_tuner.py:7-39

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Data pipeline: native prefetching loader + NumPy fallback."""
 
 from .loader import TokenLoader, native_available

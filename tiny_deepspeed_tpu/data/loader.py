@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """TokenLoader: (B, T) next-token batches, produced off the critical path.
 
 Python binding (ctypes — no pybind11 in this image) over the native C++

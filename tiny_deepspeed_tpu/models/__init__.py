@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Model zoo: GPT-2 family (parity with reference example/model.py) plus the
 MoE family (expert parallelism — beyond the reference, SURVEY §2.20)."""
 

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """GPT-2, pure-JAX and TPU-first.
 
 Capability parity with the reference model (example/model.py): GPTConfig

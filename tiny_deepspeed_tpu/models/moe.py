@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Mixture-of-Experts GPT: top-k routed experts with expert parallelism.
 
 ABSENT from the reference (SURVEY §2.20: no expert parallelism of any kind —

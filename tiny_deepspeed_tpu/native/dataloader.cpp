@@ -1,3 +1,6 @@
+// Copyright 2026 tiny-deepspeed-tpu authors
+// SPDX-License-Identifier: Apache-2.0
+
 // tds_dataloader: native prefetching token-batch pipeline.
 //
 // The reference has NO native components (SURVEY 2.9: 100% Python; its

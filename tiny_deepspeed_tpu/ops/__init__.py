@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Op layer: pure differentiable functions with swappable TPU kernels.
 
 Mirrors the reference op surface (tiny_deepspeed/core/module/ops/__init__.py:4-18)

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Causal self-attention compute: standard (materialized mask) and flash.
 
 Capability parity with the reference attention switch

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Pallas flash attention for TPU.
 
 The reference's "flash_attention" is a thin wrapper over torch's

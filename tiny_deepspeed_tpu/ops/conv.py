@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Conv op stubs — mirrored from the reference, which never implemented them.
 
 The reference ships empty conv files (ops/conv1d.py, conv2d.py, conv3d.py and

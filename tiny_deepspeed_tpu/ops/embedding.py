@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Embedding op: gather forward, scatter-add weight grad.
 
 Capability parity with reference ops/embedding.py (dispatch:11-31, forward via

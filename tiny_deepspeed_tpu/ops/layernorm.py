@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Fused LayerNorm: forward saves (mean, rstd); backward = dx + (dw, db).
 
 Capability parity with the reference's one hand-written kernel — the Triton

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Pallas fused LayerNorm kernels: the TPU re-design of the reference's one
 hand-written kernel (Triton, reference ops/layernorm.py:158-298).
 

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Linear op: forward + closed-form grads, TPU-first layout.
 
 Capability parity with reference ops/linear.py (dispatch:9-47, impls:50-75):

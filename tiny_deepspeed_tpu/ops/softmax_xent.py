@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Softmax cross-entropy with integer targets, computed in float32.
 
 The reference computes loss inside the model forward with

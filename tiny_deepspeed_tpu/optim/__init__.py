@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Name-keyed pytree optimizers (parity: reference core/optim/__init__.py:5-6).
 
 The sharded variants (DDPSGD/Zero1AdamW/... in the reference,

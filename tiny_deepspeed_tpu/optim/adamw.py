@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """AdamW (reference-semantics documented, quirks fixed).
 
 Parity with reference core/optim/adamw.py:10-59, with two deliberate

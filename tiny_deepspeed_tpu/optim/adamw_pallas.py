@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Pallas fused AdamW update: one VMEM pass per parameter slab.
 
 The reference's optimizer hot loop is a *python* per-param iteration issuing

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Optimizer base: pure, name-keyed, pytree-native.
 
 Parity with reference core/optim/base.py:7-26 — a dict-of-named-params

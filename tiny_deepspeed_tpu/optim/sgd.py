@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """SGD with momentum/dampening/nesterov/weight-decay/maximize.
 
 Parity with reference core/optim/sgd.py:10-46: weight decay folded into the

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Parallelism layer: mesh, partitioner ("cache rank map"), ZeRO engines.
 
 Replaces the reference's zero/{ddp,zero1,zero2,zero3} packages

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """ZeRO engines: DDP / ZeRO-1 / ZeRO-2 / ZeRO-3 as sharding strategies.
 
 This file replaces the reference's entire zero/{ddp,zero1,zero2,zero3}

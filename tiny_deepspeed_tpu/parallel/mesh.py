@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Device mesh construction + multi-host initialization.
 
 Replaces the reference's process-group bring-up

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Cache rank map: name-ordered greedy parameter -> rank partition table.
 
 Capability parity with reference core/zero/utils/partition.py:7-102 (the

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Pipeline parallelism: GPipe-style microbatch pipeline over a "pipe" mesh axis.
 
 ABSENT from the reference (SURVEY §2.20: its entire parallelism surface is

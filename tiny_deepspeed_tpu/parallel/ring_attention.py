@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Ring attention: causal attention over a sequence-sharded mesh axis.
 
 ABSENT from the reference (SURVEY §2.20, §5.7: max context = block_size 1024,

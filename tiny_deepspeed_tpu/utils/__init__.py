@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Utilities: checkpointing, profiling, metrics."""
 
 from .checkpoint import save_checkpoint, load_checkpoint, latest_step

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Checkpoint/resume for sharded train state (Orbax-backed).
 
 The reference has NO save/load anywhere — no state_dict on its optimizers,

@@ -1,3 +1,6 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 """Tracing, timing, and communication-cost reporting.
 
 The reference's entire observability surface is the autotuner's wall-clock
