@@ -79,6 +79,14 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "default: synthetic random tokens, the reference demo workload",
     )
     p.add_argument(
+        "--autotune", nargs="?", const="", default=None, metavar="CACHE.json",
+        help="runtime-autotune kernel candidates (flash-attention blocks, "
+             "linear layouts, layernorm Pallas-vs-XLA): first step records "
+             "requests, they are timed on device, the step re-jits with "
+             "winners baked.  With a path, winners persist across runs "
+             "(ahead-of-time cache)",
+    )
+    p.add_argument(
         "--save-every", type=int, default=0, metavar="N",
         help="write a sharded Orbax checkpoint of the TrainState every N "
              "iters into --save-dir (reference has no checkpointing, "
@@ -160,6 +168,44 @@ def run(engine_cls, args, single_device=False):
                          vocab_size=vocab, seed=args.seed)
     for _ in range(start_iter):  # replay position -> trajectory continuity
         loader.next()
+
+    if getattr(args, "autotune", None) is not None:
+        if jax.process_count() > 1:
+            # per-host timing could pick DIVERGENT winners -> the hosts
+            # would compile different SPMD programs and hang at the next
+            # collective; tune single-host, ship the cache file instead
+            if jax.process_index() == 0:
+                print("autotune skipped: multi-host run (tune on one host "
+                      "and pass the saved cache file)")
+        else:
+            from tiny_deepspeed_tpu.autotuner import (
+                RuntimeAutoTuner, set_default_tuner,
+            )
+            import os as _os
+            tuner = RuntimeAutoTuner(verbose=True)
+            if args.autotune and _os.path.exists(args.autotune):
+                tuner.load(args.autotune)
+            set_default_tuner(tuner)
+            # lifecycle: trace once (records candidate requests), time them
+            # on device, re-jit with winners baked (engine.retune
+            # docstring).  Probe batch is synthetic — shapes are all that
+            # matter.
+            probe = jax.random.randint(
+                jax.random.PRNGKey(7), (b, args.seq_len), 0, vocab, jnp.int32
+            )
+            state, _ = engine.step(state, (probe, probe))
+            n = engine.retune()
+            print(f"autotuned {n} site(s)")
+            if args.autotune:
+                tuner.save(args.autotune)
+            # re-create training state so the probe step does not advance
+            # it; drop the probe state FIRST (holding both would double
+            # peak state memory exactly on near-HBM-limit runs)
+            state = None
+            state = (load_checkpoint(args.save_dir, engine,
+                                     step=resume_step)
+                     if resume_step is not None
+                     else engine.init(jax.random.PRNGKey(args.seed)))
 
     t0 = time.perf_counter()
     ran = 0

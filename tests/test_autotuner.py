@@ -131,6 +131,40 @@ class TestPendingLifecycle:
             set_default_tuner(None)
 
 
+class TestPersistence:
+    """Ahead-of-time autotune cache: winners survive the process (the
+    reference re-times every run; TPU timing costs real compiles)."""
+
+    def test_save_load_roundtrip(self, tmp_path):
+        t = RuntimeAutoTuner(warmup=1, iters=1)
+        x = jnp.ones((64, 64))
+        winner = t.choose([slow, fast], (x,))
+        p = str(tmp_path / "tune.json")
+        assert t.save(p) == 1
+
+        t2 = RuntimeAutoTuner(warmup=1, iters=1)
+        assert t2.load(p) == 1
+        # no timing happens: the stored name resolves against the live list
+        got = t2.choose([slow, fast], (x,))
+        assert got is winner
+        assert len(t2.cache) == 1
+
+    def test_stored_name_must_match_candidates(self, tmp_path):
+        t = RuntimeAutoTuner(warmup=1, iters=1)
+        x = jnp.ones((32, 32))
+        t.choose([slow, fast], (x,))
+        p = str(tmp_path / "tune.json")
+        t.save(p)
+        t2 = RuntimeAutoTuner(warmup=1, iters=1)
+        t2.load(p)
+        # different candidate list -> different key -> stored entry ignored,
+        # normal timing path runs
+        def other(z):
+            return z * 2.0
+        got = t2.choose([other, fast], (x,))
+        assert got in (other, fast)
+
+
 class TestOpsWiring:
     """The tuner is consulted by real op dispatch sites with >=2 genuine
     candidates (round-1 verdict weak #4: 'the autotuner mostly tunes

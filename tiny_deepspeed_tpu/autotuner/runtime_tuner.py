@@ -40,6 +40,11 @@ class RuntimeAutoTuner:
         # from inside a trace, to be timed by resolve_pending()
         self.pending: Dict[Tuple, Tuple] = {}
         self.frozen = False
+        # bumped whenever TIMING produces a new winner (not on AOT-stored
+        # hits, which the requesting trace already used); consumers compare
+        # against the version they compiled with to decide whether a
+        # re-trace would change anything (engine.retune)
+        self.version = 0
 
     # -- key / input synthesis --------------------------------------------
 
@@ -109,6 +114,13 @@ class RuntimeAutoTuner:
         key = self._key(candidates, args)
         if key in self.cache:
             return self.cache[key]
+        stored = getattr(self, "_stored", None)
+        if stored and key in stored:  # ahead-of-time cache hit (see load())
+            name = stored[key]
+            for c in candidates:
+                if c.__module__ + "." + c.__name__ == name:
+                    self.cache[key] = c
+                    return c
         if self.frozen:
             return candidates[0]
         # `choose` usually runs INSIDE an outer jit trace (op dispatch
@@ -145,6 +157,7 @@ class RuntimeAutoTuner:
             )
             print(f"autotuner: {ranking} -> {candidates[best].__name__}")
         self.cache[key] = candidates[best]
+        self.version += 1
         return candidates[best]
 
     def resolve_pending(self) -> int:
@@ -167,6 +180,48 @@ class RuntimeAutoTuner:
     def final_tune(self) -> None:
         """Freeze: no further timing; cached winners stay (reference :31-32)."""
         self.frozen = True
+
+    # -- persistence: ahead-of-time autotune cache --------------------------
+    #
+    # The reference re-times candidates every process (its cache is a dict
+    # on the tuner instance, runtime_tuner.py:7-39).  Timing on TPU costs
+    # real compiles, so winners can be saved once and reloaded: the cache
+    # serializes as {key-json: winner qualified name} and `choose` resolves
+    # a stored name against the live candidate list.
+
+    def save(self, path: str) -> int:
+        """Write the winner table as JSON; returns entries written.
+        Loaded entries not re-hit this run are preserved (a shared cache
+        file across model configs must not lose the other configs'
+        winners on overwrite)."""
+        import json
+        table = {
+            json.dumps(key): name
+            for key, name in getattr(self, "_stored", {}).items()
+        }
+        table.update({
+            json.dumps(key): fn.__module__ + "." + fn.__name__
+            for key, fn in self.cache.items()
+        })
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(table, f, indent=1)
+        return len(table)
+
+    def load(self, path: str) -> int:
+        """Read a winner table; entries resolve lazily at choose() time
+        (a stored name only applies when it matches one of the live
+        candidates for that key).  Returns entries read."""
+        import json
+
+        def tuplify(x):
+            return tuple(tuplify(i) for i in x) if isinstance(x, list) else x
+
+        with open(path, encoding="utf-8") as f:
+            table = json.load(f)
+        self._stored = {
+            tuplify(json.loads(key_s)): name for key_s, name in table.items()
+        }
+        return len(self._stored)
 
 
 _default_tuner: Optional[RuntimeAutoTuner] = None

@@ -332,10 +332,14 @@ class ZeroEngine:
             batch_spec = P(None, *batch_spec)
         self._batch_sharding = NamedSharding(mesh, batch_spec)
 
-        self._retuned = False
         self._build_step()
 
     def _build_step(self) -> None:
+        from ..autotuner import get_default_tuner
+        tuner = get_default_tuner()
+        # the winner-table version this program was traced against; retune
+        # rebuilds only when timing has produced new winners since
+        self._tuner_version = getattr(tuner, "version", 0)
         self._step = jax.jit(
             self._step_impl,
             in_shardings=(
@@ -372,12 +376,13 @@ class ZeroEngine:
         if tuner is None:
             return 0
         n = tuner.resolve_pending()
-        # rebuild also when another engine sharing the tuner already resolved
-        # our pending keys (n == 0 but winners sit in the cache and this
-        # engine's compiled step still runs candidate[0])
-        if n or (tuner.cache and not self._retuned):
+        # rebuild iff timing produced winners SINCE this program was traced —
+        # covers another engine resolving our pending keys (version moved,
+        # n == 0 here), and correctly skips the rebuild when every site was
+        # satisfied from the ahead-of-time cache during the trace (version
+        # unchanged: a re-trace would compile the identical program)
+        if tuner.version != self._tuner_version:
             self._build_step()
-            self._retuned = True
         return n
 
     # -- state creation ----------------------------------------------------
