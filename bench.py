@@ -123,12 +123,13 @@ def _bench_config(model_name: str):
 def run_one(model_name: str, b=None, t=1024, iters=30):
     import jax
     import jax.numpy as jnp
-    from tiny_deepspeed_tpu import AdamW, GPT2Model, SingleDevice, make_mesh
-    from tiny_deepspeed_tpu.models import GPT2_PRESETS
+    from tiny_deepspeed_tpu import AdamW, SingleDevice, make_mesh
+    from tiny_deepspeed_tpu.models import ALL_PRESETS, build_model
+    from tiny_deepspeed_tpu.models.llama import LlamaConfig
 
     bc = _bench_config(model_name)
     b = b or bc["batch"]
-    cfg = dataclasses.replace(GPT2_PRESETS[model_name], **bc["overrides"])
+    cfg = dataclasses.replace(ALL_PRESETS[model_name], **bc["overrides"])
 
     if os.environ.get("BENCH_AUTOTUNE"):
         # per-shape candidate timing at trace time (linear layouts, flash
@@ -139,7 +140,7 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         set_default_tuner(RuntimeAutoTuner(verbose=bool(
             os.environ.get("BENCH_AUTOTUNE_VERBOSE"))))
 
-    model = GPT2Model(cfg)
+    model = build_model(cfg)
     devices = jax.devices()
     n_chips = len(devices)
     mesh = make_mesh()
@@ -186,7 +187,10 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     # MFU, both accountings (module docstring).
     n_params = model.num_params()
     d, l, v = cfg.n_embd, cfg.n_layer, cfg.vocab_size
-    embed_params = v * d + cfg.block_size * d  # wte + wpe (gather, not matmul)
+    # wte (+ wpe for gpt2; llama has no position table) — gathers, not matmuls
+    embed_params = v * d + (
+        0 if isinstance(cfg, LlamaConfig) else cfg.block_size * d
+    )
     flops_tok_matmul = 6 * (n_params - embed_params) + 12 * l * t * d
     peak = _peak_flops_per_chip(devices[0])
     toks_per_sec_total = b * t / step_time

@@ -26,11 +26,10 @@ import jax.numpy as jnp
 
 from tiny_deepspeed_tpu import (
     AdamW,
-    GPT2Model,
     init_distributed,
     make_mesh,
 )
-from tiny_deepspeed_tpu.models import GPT2_PRESETS
+from tiny_deepspeed_tpu.models import ALL_PRESETS, build_model
 
 
 def parse_args(default_model="gpt2-124m", **defaults):
@@ -43,7 +42,7 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "a pod — the reference has no such story, SURVEY §4)",
     )
     p.add_argument(
-        "--model", default=None, choices=sorted(GPT2_PRESETS),
+        "--model", default=None, choices=sorted(ALL_PRESETS),
         help=f"default {default_model}; under --cpu-devices the default "
              "drops to 'tiny' so every entry point smoke-tests in seconds "
              "(XLA-CPU compile of a full-size step takes minutes)",
@@ -106,7 +105,7 @@ def parse_args(default_model="gpt2-124m", **defaults):
     if args.model is None:
         args.model = "tiny" if args.cpu_devices else default_model
     if args.seq_len is None:
-        args.seq_len = min(1024, GPT2_PRESETS[args.model].block_size)
+        args.seq_len = min(1024, ALL_PRESETS[args.model].block_size)
     return args
 
 
@@ -115,7 +114,7 @@ def run(engine_cls, args, single_device=False):
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu_devices)
     init_distributed()
-    model = GPT2Model(GPT2_PRESETS[args.model])
+    model = build_model(args.model)
 
     opt = AdamW(lr=args.lr, weight_decay=args.weight_decay)
     if single_device:

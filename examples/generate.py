@@ -1,0 +1,72 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Sampling entry point: load (or init) a model and generate tokens.
+
+The reference has no inference path at all (its GPT2Model only trains,
+reference example/model.py:139-157); `GPT2Model.generate` is the
+fixed-shape lax.fori_loop decode this script exposes.  Pairs with the
+training entry points' `--save-every` checkpoints.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    p = argparse.ArgumentParser()
+    from tiny_deepspeed_tpu.models import ALL_PRESETS
+    p.add_argument("--model", default="tiny", choices=sorted(ALL_PRESETS))
+    p.add_argument("--ckpt", default=None, metavar="DIR",
+                   help="checkpoint dir from --save-every (default: fresh "
+                        "random init — demonstrates the decode path)")
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top-k", type=int, default=50)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cpu", action="store_true", help="force CPU backend")
+    args = p.parse_args()
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from tiny_deepspeed_tpu import SGD, SingleDevice
+    from tiny_deepspeed_tpu.models import build_model
+
+    model = build_model(args.model)
+    cfg = model.config
+
+    if args.ckpt:
+        from tiny_deepspeed_tpu.utils.checkpoint import load_checkpoint
+        engine = SingleDevice(model, SGD(lr=0.0))
+        params = load_checkpoint(args.ckpt, engine).params
+        print(f"loaded params from {args.ckpt}")
+    else:
+        params = model.init(jax.random.PRNGKey(args.seed))
+        print("fresh random init (pass --ckpt for trained weights)")
+
+    key = jax.random.PRNGKey(args.seed)
+    prompt = jax.random.randint(
+        key, (args.batch, args.prompt_len), 0, cfg.vocab_size, jnp.int32
+    )
+    out = model.generate(
+        params, prompt, args.max_new_tokens,
+        temperature=args.temperature, top_k=args.top_k,
+        key=jax.random.PRNGKey(args.seed + 1),
+    )
+    for row in out:
+        toks = [int(t) for t in row]
+        print(f"prompt={toks[:args.prompt_len]} -> "
+              f"generated={toks[args.prompt_len:]}")
+
+
+if __name__ == "__main__":
+    main()

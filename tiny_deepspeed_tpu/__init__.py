@@ -34,7 +34,9 @@ from .parallel.partition import partition_tensors, materialize_owned
 from .parallel.engine import SingleDevice, DDP, Zero1, Zero2, Zero3
 from .parallel.mesh import make_mesh, init_distributed
 from .optim import SGD, AdamW
-from .models import GPTConfig, GPT2Model, MoEConfig, MoEGPT
+from .models import (
+    GPTConfig, GPT2Model, MoEConfig, MoEGPT, LlamaConfig, LlamaModel,
+)
 
 # Reference-shaped optimizer names (reference core/__init__.py:5-23 exports
 # DDPSGD/DDPAdamW/Zero{1,2,3}SGD/Zero{1,2,3}AdamW — one subclass per mode
@@ -67,4 +69,6 @@ __all__ = [
     "GPT2Model",
     "MoEConfig",
     "MoEGPT",
+    "LlamaConfig",
+    "LlamaModel",
 ]

@@ -214,16 +214,15 @@ class GPT2Model:
         h = linear(h, bp["mlp.proj.w"], bp["mlp.proj.b"])
         return x + h
 
-    def embed(self, params, idx, pctx=None):
-        """Token + position embedding -> (B, T, D) in compute dtype."""
+    def embed_tokens(self, params, idx):
+        """wte gather (+ optional row-norm cap) -> (B, T, D) compute dtype.
+        Shared across families; raises on over-length sequences."""
         c = self.config
-        cd = c.compute_dtype
-        b, t = idx.shape
+        t = idx.shape[1]
         if t > c.block_size:
             raise ValueError(
                 f"sequence length {t} > block_size {c.block_size}"
             )  # reference asserts the same (model.py:142)
-
         tok = embedding(idx, params["wte"])
         if c.wte_max_norm is not None:
             # cap the GATHERED rows, not the whole (vocab, d) table — same
@@ -231,10 +230,10 @@ class GPT2Model:
             # O(vocab*d) per forward (and per remat re-forward)
             from ..ops.embedding import renorm_weight
             tok = renorm_weight(tok, c.wte_max_norm)
-        tok = tok.astype(cd)
-        pos = params["wpe"][:t].astype(cd)
-        x = tok + pos[None]
+        return tok.astype(c.compute_dtype)
 
+    @staticmethod
+    def _constrain_activations(x, pctx):
         if pctx is not None and pctx.is_multi_device:
             from jax.sharding import NamedSharding, PartitionSpec as P
             x = jax.lax.with_sharding_constraint(
@@ -243,6 +242,13 @@ class GPT2Model:
                 ),
             )
         return x
+
+    def embed(self, params, idx, pctx=None):
+        """Token + position embedding -> (B, T, D) in compute dtype."""
+        t = idx.shape[1]
+        tok = self.embed_tokens(params, idx)
+        pos = params["wpe"][:t].astype(tok.dtype)
+        return self._constrain_activations(tok + pos[None], pctx)
 
     def stacked_compute_params(self, params):
         """The per-block scan xs: "h.*" tensors cast to compute dtype ONCE
@@ -273,12 +279,21 @@ class GPT2Model:
             block = jax.checkpoint(block, policy=self.remat_policy())
         return block
 
+    def final_norm(self, params, x):
+        """Pre-head normalization — the one hook model families override
+        (LlamaModel swaps in rmsnorm); the head/loss policy below stays in
+        exactly one place."""
+        cd = self.config.compute_dtype
+        return layernorm(
+            x, params["ln_f.w"].astype(cd), params["ln_f.b"].astype(cd)
+        )
+
     def head(self, params, x, targets: Optional[jax.Array] = None,
              pctx=None, position=None):
-        """Final layernorm + lm_head (+ loss when targets given)."""
+        """Final norm + lm_head (+ loss when targets given)."""
         c = self.config
         cd = c.compute_dtype
-        x = layernorm(x, params["ln_f.w"].astype(cd), params["ln_f.b"].astype(cd))
+        x = self.final_norm(params, x)
 
         if targets is not None:
             seq_sharded = pctx is not None and pctx.seq_parallel

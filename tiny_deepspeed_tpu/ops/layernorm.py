@@ -150,7 +150,9 @@ def _layernorm_bwd_rule(eps, res, gy):
     x, w, mean, rstd = res
     dx = layernorm_dx(gy, x, w, mean, rstd)
     dw, db = layernorm_dwdb(gy, x, mean, rstd)
-    return dx, dw, db
+    # cotangent dtypes must match the primals' (the dwdb impls emit
+    # x.dtype; w/b may be f32 masters while x is bf16)
+    return dx, dw.astype(w.dtype), db.astype(w.dtype)
 
 
 layernorm.defvjp(_layernorm_fwd_rule, _layernorm_bwd_rule)

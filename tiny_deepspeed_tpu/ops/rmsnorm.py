@@ -1,0 +1,69 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""RMSNorm: the Llama-family normalization (no mean subtraction, no bias).
+
+No reference counterpart (the reference's only norm is the Triton layernorm,
+reference ops/layernorm.py) — this op exists for the Llama model family
+(models/llama.py), built on the same dispatch pattern as ops/layernorm.py:
+pure fns + custom_vjp with a closed-form backward, float32 row statistics
+regardless of input dtype.
+
+  y    = w * x * rstd,   rstd = (mean(x^2, -1) + eps)^-1/2
+  dx   = rstd*(gy*w) - x * rstd^3 * mean(gy*w*x, -1)
+  dw   = sum_rows(gy * x * rstd)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm_fwd(x, w, eps=1e-5):
+    """Returns (y, rstd); rstd float32, shape x.shape[:-1]."""
+    xf = x.astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1) + eps)
+    y = xf * rstd[..., None] * w.astype(jnp.float32)
+    return y.astype(x.dtype), rstd
+
+
+def rmsnorm_dx(gy, x, w, rstd):
+    n = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    gyw = gy.astype(jnp.float32) * w.astype(jnp.float32)
+    r = rstd[..., None]
+    c = jnp.sum(gyw * xf, axis=-1, keepdims=True) / n
+    dx = gyw * r - xf * (r ** 3) * c
+    return dx.astype(x.dtype)
+
+
+def rmsnorm_dw(gy, x, rstd):
+    xf = x.astype(jnp.float32)
+    gyf = gy.astype(jnp.float32)
+    axes = tuple(range(gy.ndim - 1))
+    dw = jnp.sum(gyf * xf * rstd[..., None], axis=axes)
+    return dw.astype(x.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rmsnorm(x, w, eps=1e-5):
+    return rmsnorm_fwd(x, w, eps)[0]
+
+
+def _rms_fwd_rule(x, w, eps):
+    y, rstd = rmsnorm_fwd(x, w, eps)
+    return y, (x, w, rstd)
+
+
+def _rms_bwd_rule(eps, res, gy):
+    x, w, rstd = res
+    # cotangent dtypes must match the PRIMALS' dtypes — x and w may differ
+    # (f32 master weight, bf16 activations)
+    return (rmsnorm_dx(gy, x, w, rstd),
+            rmsnorm_dw(gy, x, rstd).astype(w.dtype))
+
+
+rmsnorm.defvjp(_rms_fwd_rule, _rms_bwd_rule)
