@@ -86,6 +86,16 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "(ahead-of-time cache)",
     )
     p.add_argument(
+        "--profile", default=None, metavar="LOGDIR",
+        help="capture a jax.profiler device trace (XPlane/TensorBoard) of "
+             "iters 2-4 into LOGDIR (utils/profiling.trace)",
+    )
+    p.add_argument(
+        "--metrics", default=None, metavar="FILE.jsonl",
+        help="append per-iter structured metrics (loss, step seconds, "
+             "tokens/s) as JSONL (utils/profiling.MetricsLogger)",
+    )
+    p.add_argument(
         "--save-every", type=int, default=0, metavar="N",
         help="write a sharded Orbax checkpoint of the TrainState every N "
              "iters into --save-dir (reference has no checkpointing, "
@@ -206,20 +216,49 @@ def run(engine_cls, args, single_device=False):
                      if resume_step is not None
                      else engine.init(jax.random.PRNGKey(args.seed)))
 
+    metrics = None
+    if getattr(args, "metrics", None):
+        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
+        metrics = MetricsLogger(args.metrics, stdout=False)
+    profile_dir = getattr(args, "profile", None)
+
+    rank0 = jax.process_index() == 0
+    trace_started = False
     t0 = time.perf_counter()
     ran = 0
     for it in range(start_iter, args.iters):
+        it_t0 = time.perf_counter()
+        if profile_dir is not None and it == start_iter + 2:
+            jax.profiler.start_trace(profile_dir)
+            trace_started = True
         idx, tgt = loader.next()
         state, loss = engine.step(state, (jnp.asarray(idx), jnp.asarray(tgt)))
         ran += 1
-        if jax.process_index() == 0:
-            print(f"iter {it:3d} loss {float(loss):.4f}")
+        if rank0:
+            # device->host sync (axon-safe barrier) only where the value is
+            # consumed — other ranks run ahead and overlap loader.next()
+            # with device compute (MetricsLogger.log is rank-0 gated too)
+            loss_f = float(loss)
+            it_dt = time.perf_counter() - it_t0
+            print(f"iter {it:3d} loss {loss_f:.4f}")
+            if metrics is not None:
+                metrics.log(it, loss=loss_f, step_s=it_dt,
+                            tokens_per_s=b * args.seq_len / max(it_dt, 1e-9))
+        if trace_started and it == start_iter + 4:
+            jax.profiler.stop_trace()
+            trace_started = False
+            if rank0:
+                print(f"profiler trace written to {profile_dir}")
         if getattr(args, "save_every", 0) and (it + 1) % args.save_every == 0:
             from tiny_deepspeed_tpu.utils.checkpoint import save_checkpoint
             save_checkpoint(args.save_dir, state, it + 1)
-            if jax.process_index() == 0:
+            if rank0:
                 print(f"saved checkpoint at iter {it + 1}")
+    if trace_started:  # run shorter than the trace window
+        jax.profiler.stop_trace()
     loader.close()
+    if metrics is not None:
+        metrics.close()
     dt = time.perf_counter() - t0
     if jax.process_index() == 0:
         toks = ran * b * args.seq_len

@@ -54,6 +54,26 @@ def test_kill_and_resume_matches_uninterrupted(tmp_path):
         )
 
 
+def test_profile_and_metrics_flags(tmp_path):
+    """--profile writes an XPlane trace dir; --metrics a JSONL with loss/
+    step_s/tokens_per_s per iter (SURVEY §5.1/§5.5 observability wired
+    into the entry points)."""
+    import json
+    prof = str(tmp_path / "prof")
+    metr = str(tmp_path / "m.jsonl")
+    proc = subprocess.run(
+        [sys.executable, os.path.join("examples", "ddp", "train.py"),
+         "--cpu-devices", "8", "--iters", "6",
+         "--profile", prof, "--metrics", metr],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.path.isdir(os.path.join(prof, "plugins", "profile"))
+    recs = [json.loads(ln) for ln in open(metr)]
+    assert len(recs) == 6
+    assert {"loss", "step_s", "tokens_per_s"} <= set(recs[0])
+
+
 @pytest.mark.parametrize("name", EXAMPLES)
 def test_example_smoke(name):
     proc = subprocess.run(
