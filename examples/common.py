@@ -254,8 +254,11 @@ def run(engine_cls, args, single_device=False):
             save_checkpoint(args.save_dir, state, it + 1)
             if rank0:
                 print(f"saved checkpoint at iter {it + 1}")
-    if trace_started:  # run shorter than the trace window
+    if trace_started:  # run ended inside the trace window
         jax.profiler.stop_trace()
+    elif profile_dir is not None and args.iters - start_iter <= 2 and rank0:
+        print(f"--profile: run too short (< 3 iters past {start_iter}) — "
+              f"no trace captured in {profile_dir}")
     loader.close()
     if metrics is not None:
         metrics.close()

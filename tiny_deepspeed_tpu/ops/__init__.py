@@ -4,8 +4,9 @@
 """Op layer: pure differentiable functions with swappable TPU kernels.
 
 Mirrors the reference op surface (tiny_deepspeed/core/module/ops/__init__.py:4-18)
-— linear, layernorm, embedding, conv stubs — but as JAX pure functions with
-`custom_vjp` rules instead of torch autograd.Function pairs.  Each op has:
+— linear, layernorm, embedding, conv (which the reference left as empty
+files; completed here) — but as JAX pure functions with `custom_vjp` rules
+instead of torch autograd.Function pairs.  Each op has:
 
   * a dispatch wrapper accepting an optional `tuner` (the reference threads a
     `RuntimeAutoTuner` through every dispatch site, ops/linear.py:9-47);
@@ -39,6 +40,15 @@ from .embedding import (
 )
 from .attention import standard_attention, flash_attention
 from .softmax_xent import softmax_cross_entropy
+from .rmsnorm import rmsnorm
+from .conv import (
+    conv1d_forward,
+    conv2d_forward,
+    conv3d_forward,
+    conv1d,
+    conv2d,
+    conv3d,
+)
 
 __all__ = [
     "linear_forward",
@@ -56,4 +66,11 @@ __all__ = [
     "standard_attention",
     "flash_attention",
     "softmax_cross_entropy",
+    "rmsnorm",
+    "conv1d_forward",
+    "conv2d_forward",
+    "conv3d_forward",
+    "conv1d",
+    "conv2d",
+    "conv3d",
 ]

@@ -1,27 +1,200 @@
 # Copyright 2026 tiny-deepspeed-tpu authors
 # SPDX-License-Identifier: Apache-2.0
 
-"""Conv op stubs — mirrored from the reference, which never implemented them.
+"""Conv ops: the layer the reference intended but never wrote, completed.
 
-The reference ships empty conv files (ops/conv1d.py, conv2d.py, conv3d.py and
-module/conv.py each contain only a license header — reference §2.6).  We keep
-the same surface so the inventories line up, but raise explicitly instead of
-silently exporting nothing.
+The reference ships EMPTY conv files (ops/conv1d.py, conv2d.py, conv3d.py
+and module/conv.py contain only license headers — reference §2.6, SURVEY
+quirk #15).  Round 1 mirrored them as NotImplementedError stubs; this
+completes the surface the reference planned, in the same decomposed-op
+style as ops/linear.py:
+
+  conv{1,2,3}d_forward   y = conv(x, w) + b
+  conv_input_grad        dx (transpose conv — XLA-derived, see below)
+  conv_weight_grad       dw
+  conv_bias_grad         db
+  conv1d/conv2d/conv3d   custom_vjp wrappers exposing that decomposition
+
+TPU-first choices:
+  * channel-LAST layouts: x (B, *spatial, Cin), w (*spatial, Cin/groups,
+    Cout) — the (8, 128) VREG tiling wants the contraction/channel axis
+    minor, and XLA lowers NHWC convs onto the MXU without relayout.
+  * float32 accumulation via preferred_element_type for sub-f32 inputs.
+  * dx/dw are obtained by transposing the *linear* forward (convolution is
+    linear in x and in w separately, so the cotangent maps are exact and
+    value-independent); XLA emits the usual transposed-conv /
+    kernel-gradient convolutions.  This keeps every stride / padding /
+    dilation / groups combination correct by construction instead of
+    hand-maintaining six index-arithmetic variants.
 """
 
 from __future__ import annotations
 
+from functools import partial
+from typing import Tuple
 
-def _not_implemented(name):
-    def fn(*args, **kwargs):
-        raise NotImplementedError(
-            f"{name} is a stub, mirroring the reference's empty "
-            "ops/conv{1,2,3}d.py (license headers only, never implemented)."
-        )
+import jax
+import jax.numpy as jnp
+
+from .linear import _acc_dtype
+
+
+def _tuple(v, n: int) -> Tuple[int, ...]:
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) != n:
+        raise ValueError(f"expected {n} ints, got {v}")
+    return v
+
+
+def _dimension_numbers(n: int):
+    """Channel-last dimension numbers for n spatial dims:
+    lhs (B, *S, C), rhs (*S, I, O), out (B, *S, C)."""
+    sp = "DHW"[3 - n:]
+    return jax.lax.conv_dimension_numbers(
+        (1,) * (n + 2), (1,) * (n + 2),
+        (f"N{sp}C", f"{sp}IO", f"N{sp}C"),
+    )
+
+
+def _conv_forward(x, w, b, stride, padding, dilation, groups):
+    n = x.ndim - 2
+    if w.dtype != x.dtype:
+        # lax.conv requires matching operand dtypes; compute at activation
+        # precision (f32 master weights + bf16 activations).  The cast is
+        # linear, so the transposed grads stay exact and conv_weight_grad's
+        # cotangent is cast back to w.dtype in the bwd rule.
+        w = w.astype(x.dtype)
+    y = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=_tuple(stride, n),
+        padding=padding if isinstance(padding, str)
+        else [(p, p) for p in _tuple(padding, n)],
+        rhs_dilation=_tuple(dilation, n),
+        dimension_numbers=_dimension_numbers(n),
+        feature_group_count=groups,
+        preferred_element_type=_acc_dtype(x, w),
+    ).astype(x.dtype)
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y
+
+
+def conv1d_forward(x, w, b=None, stride=1, padding="SAME", dilation=1,
+                   groups=1, tuner=None):
+    """x (B, L, Cin), w (K, Cin/groups, Cout) -> (B, L', Cout)."""
+    return _conv_forward(x, w, b, stride, padding, dilation, groups)
+
+
+def conv2d_forward(x, w, b=None, stride=1, padding="SAME", dilation=1,
+                   groups=1, tuner=None):
+    """x (B, H, W, Cin), w (Kh, Kw, Cin/groups, Cout) -> (B, H', W', Cout)."""
+    return _conv_forward(x, w, b, stride, padding, dilation, groups)
+
+
+def conv3d_forward(x, w, b=None, stride=1, padding="SAME", dilation=1,
+                   groups=1, tuner=None):
+    """x (B, D, H, W, Cin), w (Kd, Kh, Kw, Cin/groups, Cout)."""
+    return _conv_forward(x, w, b, stride, padding, dilation, groups)
+
+
+def _conv_plain(x, w, stride, padding, dilation, groups):
+    """Dtype-uniform conv (no accumulate-cast boundary): the linear map the
+    grad transposes are built from.  lax.conv's transpose rule cannot cross
+    a preferred_element_type/astype boundary with mixed dtypes (it would
+    pair an f32 cotangent with a bf16 operand); TPU convs accumulate f32
+    internally for bf16 operands regardless."""
+    n = x.ndim - 2
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=_tuple(stride, n),
+        padding=padding if isinstance(padding, str)
+        else [(p, p) for p in _tuple(padding, n)],
+        rhs_dilation=_tuple(dilation, n),
+        dimension_numbers=_dimension_numbers(n),
+        feature_group_count=groups,
+    )
+
+
+def conv_input_grad(gy, x_shape, x_dtype, w, stride, padding, dilation,
+                    groups, tuner=None):
+    """dx: transpose of the conv's linear map in x (value-independent;
+    jax.linear_transpose builds it from the abstract primal without ever
+    evaluating a forward conv)."""
+    t = jax.linear_transpose(
+        lambda xx: _conv_plain(xx, w, stride, padding, dilation, groups),
+        jax.ShapeDtypeStruct(x_shape, x_dtype),
+    )
+    return t(gy.astype(x_dtype))[0]
+
+
+def conv_weight_grad(gy, x, w_shape, w_dtype, stride, padding, dilation,
+                     groups, tuner=None):
+    """dw: transpose of the conv's linear map in w (value-independent)."""
+    t = jax.linear_transpose(
+        lambda ww: _conv_plain(x, ww, stride, padding, dilation, groups),
+        jax.ShapeDtypeStruct(w_shape, x.dtype),
+    )
+    return t(gy.astype(x.dtype))[0]
+
+
+def conv_bias_grad(gy, tuner=None):
+    """db = gy summed over batch + spatial dims."""
+    return jnp.sum(
+        gy.astype(jnp.float32), axis=tuple(range(gy.ndim - 1))
+    ).astype(gy.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrappers (the stable grad decomposition, as ops/linear.py)
+# ---------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _conv(x, w, b, stride, padding, dilation, groups):
+    return _conv_forward(x, w, b, stride, padding, dilation, groups)
+
+
+def _conv_fwd_rule(x, w, b, stride, padding, dilation, groups):
+    y = _conv_forward(x, w, b, stride, padding, dilation, groups)
+    # b rides along in the residuals (a dtype is not a valid pytree leaf,
+    # and the cotangent must match b's dtype; the vector is tiny)
+    return y, (x, w, b)
+
+
+def _conv_bwd_rule(stride, padding, dilation, groups, res, gy):
+    x, w, b = res
+    b_dtype = None if b is None else b.dtype
+    dx = conv_input_grad(gy, x.shape, x.dtype, w, stride, padding,
+                         dilation, groups)
+    # cotangent dtypes must match the primals' (w/b may be f32 masters
+    # while activations are bf16)
+    dw = conv_weight_grad(gy, x, w.shape, w.dtype, stride, padding,
+                          dilation, groups).astype(w.dtype)
+    db = (None if b_dtype is None
+          else conv_bias_grad(gy).astype(b_dtype))
+    return dx, dw, db
+
+
+_conv.defvjp(_conv_fwd_rule, _conv_bwd_rule)
+
+
+def _make(n: int, name: str):
+    def fn(x, w, b=None, stride=1, padding="SAME", dilation=1, groups=1):
+        if x.ndim != n + 2:
+            raise ValueError(
+                f"{name} expects a {n + 2}-D channel-last input "
+                f"(B, *spatial, C); got shape {x.shape}"
+            )
+        return _conv(x, w, b, stride, padding, dilation, groups)
     fn.__name__ = name
+    fn.__doc__ = (
+        f"{name}(x, w, b=None, stride=1, padding='SAME', dilation=1, "
+        "groups=1) — channel-last, custom_vjp decomposed grads."
+    )
     return fn
 
 
-conv1d_forward = _not_implemented("conv1d_forward")
-conv2d_forward = _not_implemented("conv2d_forward")
-conv3d_forward = _not_implemented("conv3d_forward")
+conv1d = _make(1, "conv1d")
+conv2d = _make(2, "conv2d")
+conv3d = _make(3, "conv3d")

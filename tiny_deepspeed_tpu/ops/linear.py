@@ -122,14 +122,20 @@ def linear(x, w, b):
 
 
 def _linear_fwd_rule(x, w, b):
-    return linear_forward(x, w, b), (x, w, b is not None)
+    # b rides along in the residuals (a dtype is not a valid pytree leaf,
+    # and the cotangent must match b's dtype; the vector is tiny)
+    return linear_forward(x, w, b), (x, w, b)
 
 
 def _linear_bwd_rule(res, gy):
-    x, w, has_b = res
+    x, w, b = res
+    b_dtype = None if b is None else b.dtype
     dx = linear_input_grad(gy, w)
-    dw = linear_weight_grad(gy, x)
-    db = linear_bias_grad(gy) if has_b else None
+    # cotangent dtypes must match the primals' (w/b may be f32 masters
+    # while activations are bf16)
+    dw = linear_weight_grad(gy, x).astype(w.dtype)
+    db = (None if b_dtype is None
+          else linear_bias_grad(gy).astype(b_dtype))
     return dx, dw, db
 
 
