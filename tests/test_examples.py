@@ -89,3 +89,28 @@ def test_example_smoke(name):
         .split()[-1]
     )
     assert 5.0 < first < 8.0, proc.stdout[-2000:]
+
+
+def test_example_llama_family():
+    """Any entry point accepts the llama-* presets (one flat namespace)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("examples", "zero3", "train.py"),
+         "--cpu-devices", "8", "--iters", "2", "--model", "llama-tiny",
+         "--seq-len", "64"],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "model=llama-tiny" in proc.stdout
+    assert "done: 2 iters" in proc.stdout, proc.stdout[-2000:]
+
+
+@pytest.mark.parametrize("model", ["tiny", "llama-tiny"])
+def test_generate_entry_point(model):
+    """examples/generate.py samples from both families without a ckpt."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("examples", "generate.py"),
+         "--cpu", "--model", model, "--max-new-tokens", "8"],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "generated=" in proc.stdout, proc.stdout[-2000:]
