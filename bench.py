@@ -24,7 +24,8 @@ counting embedding params whose forward is a gather):
   * `mfu_6n` — the naive 6 * total-params number, for comparability.
 
 `python bench.py --sweep` measures every single-chip row of the BASELINE.md
-matrix (124M / 350M / 774M / 1.5B) and prints one JSON line per config.
+matrix (GPT-2 124M / 350M / 774M / 1.5B) plus a Llama-160M datapoint, one
+JSON line per config.
 """
 
 import dataclasses
@@ -244,7 +245,8 @@ def main():
         _retry_or_diagnose(e)
 
     if sweep:
-        models = ["gpt2-124m", "gpt2-350m", "gpt2-774m", "gpt2-1.5b"]
+        models = ["gpt2-124m", "gpt2-350m", "gpt2-774m", "gpt2-1.5b",
+                  "llama-160m"]
         for name in models:
             rec = None
             for attempt in range(3):  # inline retry for transient outages

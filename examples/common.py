@@ -60,7 +60,12 @@ def parse_args(default_model="gpt2-124m", **defaults):
     )
     p.add_argument(
         "--seq-parallel", type=int, default=1, metavar="SP",
-        help="ring-attention context parallelism over a 'seq' mesh axis",
+        help="sequence/context parallelism over a 'seq' mesh axis",
+    )
+    p.add_argument(
+        "--seq-impl", default="ring", choices=("ring", "ulysses"),
+        help="sequence-parallel attention: ppermute ring (O(T/n) memory) "
+             "or DeepSpeed-Ulysses all-to-all head/seq reshard",
     )
     p.add_argument(
         "--pipeline-parallel", type=int, default=1, metavar="PP",
@@ -137,6 +142,7 @@ def run(engine_cls, args, single_device=False):
         engine = engine_cls(
             model, opt,
             seq_parallel=getattr(args, "seq_parallel", 1),
+            seq_impl=getattr(args, "seq_impl", "ring"),
             tensor_parallel=getattr(args, "tensor_parallel", 1),
             pipeline_parallel=getattr(args, "pipeline_parallel", 1),
             pipeline_microbatches=getattr(args, "pipeline_microbatches", 0)

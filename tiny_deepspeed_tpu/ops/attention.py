@@ -91,9 +91,10 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
       the kernel would force an all-gather of the batch)
     * otherwise                -> jnp path, GSPMD partitions the einsums
     """
+    base_fn = (flash_attention if impl == "flash_attention"
+               else standard_attention)
     if pctx is None or not pctx.is_multi_device:
-        return (flash_attention if impl == "flash_attention"
-                else standard_attention)(q, k, v)
+        return base_fn(q, k, v)
 
     from ..parallel.ring_attention import ring_attention
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -104,15 +105,38 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
     head_axis = pctx.model_axis if pctx.tensor_parallel else None
 
     if pctx.seq_parallel:
+        ulysses = getattr(pctx, "seq_impl", "ring") == "ulysses"
         if pctx.pipe_parallel:
             # inside the pipeline's shard_map, which is manual over BOTH
             # {pipe, seq} (parallel/pipeline.py): q/k/v are already local
-            # (T/n) shards and the seq axis is manual, so the ring body is
-            # called directly — wrapping another shard_map would fail
+            # (T/n) shards and the seq axis is manual, so the per-shard
+            # bodies are called directly — wrapping another shard_map
+            # would fail
+            if ulysses:
+                # data/TP axes are still GSPMD-auto in this region: the
+                # Pallas custom call cannot be auto-partitioned over them
+                # (it would all-gather the batch), so the local kernel is
+                # the XLA path — same reason as the plain-pipeline branch
+                from ..parallel.ulysses import ulysses_attention_local
+                return ulysses_attention_local(
+                    q, k, v, axis_name=pctx.seq_axis,
+                    attn_fn=(_sdpa_or_standard
+                             if impl == "flash_attention"
+                             else standard_attention),
+                )
             from ..parallel.ring_attention import ring_attention_local
             return ring_attention_local(
                 q, k, v, axis_name=pctx.seq_axis,
                 axis_size=pctx.mesh.shape[pctx.seq_axis],
+            )
+        if ulysses:
+            # ulysses_attention's shard_map is FULLY manual (all axes in
+            # its specs), so the Pallas kernel runs per-shard safely
+            from ..parallel.ulysses import ulysses_attention
+            return ulysses_attention(
+                q, k, v, pctx.mesh, seq_axis=pctx.seq_axis,
+                batch_axis=pctx.data_axis, head_axis=head_axis,
+                attn_fn=base_fn,
             )
         return ring_attention(
             q, k, v, pctx.mesh, seq_axis=pctx.seq_axis,
@@ -147,5 +171,4 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
         sh = NamedSharding(pctx.mesh, P(pctx.data_axis, head_axis, None, None))
         q, k, v = (jax.lax.with_sharding_constraint(z, sh) for z in (q, k, v))
 
-    return (flash_attention if impl == "flash_attention"
-            else standard_attention)(q, k, v)
+    return base_fn(q, k, v)

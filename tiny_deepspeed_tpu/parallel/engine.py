@@ -182,6 +182,7 @@ class ZeroEngine:
         evenness_priority: float = 0.0,
         donate: bool = True,
         seq_parallel: int = 1,
+        seq_impl: str = "ring",
         tensor_parallel: int = 1,
         expert_parallel: int = 1,
         pipeline_parallel: int = 1,
@@ -189,7 +190,10 @@ class ZeroEngine:
     ):
         """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
         tokens shard over it and attention runs as a ppermute ring
-        (context parallelism).  tensor_parallel > 1 carves a "model" axis:
+        (context parallelism) or, with seq_impl="ulysses", as the
+        DeepSpeed-Ulysses all-to-all head/sequence reshard (two
+        collectives + the plain local kernel; needs n_head/tp divisible
+        by the seq size).  tensor_parallel > 1 carves a "model" axis:
         Megatron-style intra-layer sharding per the model's `tp_rules()`.
         expert_parallel > 1 carves an "expert" axis: MoE expert sharding per
         `ep_rules()`.  pipeline_parallel > 1 carves a "pipe" axis: the
@@ -247,11 +251,26 @@ class ZeroEngine:
                 "forward (pipeline_capable=False); pipeline_parallel would "
                 "silently run un-pipelined with the layer axis sharded"
             )
+        if seq_impl not in ("ring", "ulysses"):
+            raise ValueError(f"seq_impl must be 'ring' or 'ulysses', "
+                             f"got {seq_impl!r}")
+        if seq_impl == "ulysses" and self.seq_axis is not None:
+            nh = getattr(getattr(model, "config", None), "n_head", None)
+            tp_size = (mesh.shape[self.model_axis]
+                       if self.model_axis is not None else 1)
+            sp_size = mesh.shape[self.seq_axis]
+            if nh is not None and (nh // tp_size) % sp_size:
+                raise ValueError(
+                    f"seq_impl='ulysses' needs local heads "
+                    f"(n_head {nh} / tp {tp_size}) divisible by the seq "
+                    f"axis size {sp_size} — use seq_impl='ring' instead"
+                )
         self.pctx = ParallelContext(
             mesh=mesh, data_axis="data", seq_axis=self.seq_axis,
             model_axis=self.model_axis, expert_axis=self.expert_axis,
             pipe_axis=self.pipe_axis,
             pipe_microbatches=int(pipeline_microbatches or 0),
+            seq_impl=seq_impl,
         )
         self.accum_steps = int(accum_steps)
         self.n_dev = mesh.devices.size

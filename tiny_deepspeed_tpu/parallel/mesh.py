@@ -155,6 +155,10 @@ class ParallelContext:
     expert_axis: _Optional[str] = None
     pipe_axis: _Optional[str] = None
     pipe_microbatches: int = 0
+    # sequence-parallel attention mechanism: "ring" (ppermute K/V rotation,
+    # O(T/n) memory — parallel/ring_attention.py) or "ulysses" (all-to-all
+    # head/sequence reshard, DeepSpeed-Ulysses — parallel/ulysses.py)
+    seq_impl: str = "ring"
 
     @property
     def is_multi_device(self) -> bool:

@@ -1,0 +1,71 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+DeepSpeed's own long-sequence mechanism (DeepSpeed-Ulysses) — absent from
+the reference (SURVEY §5.7: "no ring attention, no Ulysses"), and the
+natural complement to parallel/ring_attention.py here:
+
+  * ring attention keeps Q/K/V sequence-sharded and rotates K/V blocks via
+    ppermute: communication O(T/n) per hop, n hops, memory O(T/n) — best
+    for very long T.
+  * Ulysses all-to-alls the (heads, sequence) layout instead: each device
+    trades its T/n slice of ALL heads for the FULL sequence of H/n heads,
+    runs plain (flash) attention on whole sequences locally, and
+    all-to-alls back.  Two collectives total, and the local attention is
+    the unmodified single-device kernel — best when H >= n and T fits one
+    device's attention working set.
+
+Layout ride: (B, H, T/n, Dh) --all_to_all(split H, concat T)--> (B, H/n,
+T, Dh) -> attention -> inverse all_to_all.  On a TPU mesh the all-to-all
+rides ICI; requires n_head % n == 0 (validated by the engine).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ulysses_attention_local(q, k, v, *, axis_name: str, attn_fn):
+    """Per-shard body (call inside a region manual over `axis_name`).
+
+    q/k/v: (B, H, T/n, Dh) local sequence shards, FULL head count.
+    attn_fn: causal attention on (B, H/n, T, Dh) — the plain single-device
+    kernel (flash on TPU, fused-XLA elsewhere).
+    """
+    def to_heads(x):  # (B, H, T/n, Dh) -> (B, H/n, T, Dh)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def to_seq(x):    # (B, H/n, T, Dh) -> (B, H, T/n, Dh)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    return to_seq(attn_fn(to_heads(q), to_heads(k), to_heads(v)))
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                      batch_axis=None, head_axis=None, attn_fn=None):
+    """shard_map entry: q/k/v (B, H, T, Dh) with T sharded over `seq_axis`.
+
+    `head_axis` (tensor parallelism) composes: heads already split over the
+    "model" axis stay split; the all-to-all only trades the REMAINING local
+    heads against the sequence."""
+    if attn_fn is None:
+        from ..ops.attention import flash_attention
+        attn_fn = flash_attention
+    n = mesh.shape[seq_axis]
+    spec = P(batch_axis, head_axis, seq_axis, None)
+    fn = functools.partial(
+        ulysses_attention_local, axis_name=seq_axis, attn_fn=attn_fn
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
