@@ -93,6 +93,10 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
     """
     base_fn = (flash_attention if impl == "flash_attention"
                else standard_attention)
+    # non-Pallas fallback for partial-manual regions where the custom call
+    # cannot be auto-partitioned over the remaining GSPMD axes
+    local_fn = (_sdpa_or_standard if impl == "flash_attention"
+                else standard_attention)
     if pctx is None or not pctx.is_multi_device:
         return base_fn(q, k, v)
 
@@ -119,10 +123,7 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
                 # the XLA path — same reason as the plain-pipeline branch
                 from ..parallel.ulysses import ulysses_attention_local
                 return ulysses_attention_local(
-                    q, k, v, axis_name=pctx.seq_axis,
-                    attn_fn=(_sdpa_or_standard
-                             if impl == "flash_attention"
-                             else standard_attention),
+                    q, k, v, axis_name=pctx.seq_axis, attn_fn=local_fn,
                 )
             from ..parallel.ring_attention import ring_attention_local
             return ring_attention_local(
@@ -155,8 +156,7 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
             q, k, v = (
                 jax.lax.with_sharding_constraint(z, sh) for z in (q, k, v)
             )
-        return (_sdpa_or_standard if impl == "flash_attention"
-                else standard_attention)(q, k, v)
+        return local_fn(q, k, v)
 
     if impl == "flash_attention" and jax.default_backend() == "tpu":
         spec = P(pctx.data_axis, head_axis, None, None)
