@@ -52,7 +52,42 @@ def parse_args(default_model="gpt2-124m", **defaults):
     p.add_argument("--seq-len", type=int, default=None,
                    help="default min(1024, model block_size)")
     p.add_argument("--lr", type=float, default=1e-5)
+    p.add_argument(
+        "--lr-schedule", default="constant",
+        choices=("constant", "warmup_linear", "warmup_cosine",
+                 "inverse_sqrt"),
+        help="learning-rate schedule over --iters with --lr as the peak "
+             "(optim/schedule.py; the reference hard-codes a constant lr, "
+             "reference ddp/train.py:27)",
+    )
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear warmup steps for --lr-schedule")
     p.add_argument("--weight-decay", type=float, default=0.1)
+    p.add_argument(
+        "--grad-clip", type=float, default=0.0, metavar="NORM",
+        help="clip gradients to this global L2 norm (0 = off)",
+    )
+    p.add_argument(
+        "--dropout", type=float, default=0.0, metavar="P",
+        help="residual/embedding dropout rate (the reference's config knob, "
+             "implemented working — its own wiring is dead code, reference "
+             "model.py:79-81)",
+    )
+    def _loss_scale(v):
+        if v == "dynamic":
+            return v
+        try:
+            return float(v)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"{v!r} is not a number or 'dynamic'"
+            )
+
+    p.add_argument(
+        "--loss-scale", type=_loss_scale, default=None, metavar="S",
+        help="loss scaling: a number (static) or 'dynamic' (fp16 AMP; "
+             "halve on overflow + skip the step, grow on a clean streak)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--tensor-parallel", type=int, default=1, metavar="TP",
@@ -129,12 +164,39 @@ def run(engine_cls, args, single_device=False):
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu_devices)
     init_distributed()
-    model = build_model(args.model)
+    model_cfg = ALL_PRESETS[args.model]
+    if getattr(args, "dropout", 0.0):
+        import dataclasses as _dc
+        if not any(f.name == "dropout"
+                   for f in _dc.fields(type(model_cfg))):
+            raise SystemExit(
+                f"--dropout: the {type(model_cfg).__name__} family has no "
+                "dropout knob"
+            )
+        model_cfg = _dc.replace(model_cfg, dropout=args.dropout)
+    model = build_model(model_cfg)
 
-    opt = AdamW(lr=args.lr, weight_decay=args.weight_decay)
+    lr = args.lr
+    sched_name = getattr(args, "lr_schedule", "constant")
+    if sched_name != "constant" or getattr(args, "warmup_steps", 0):
+        from tiny_deepspeed_tpu.optim import schedule as _sched
+        kw = {"warmup_steps": args.warmup_steps}
+        if sched_name == "constant":
+            sched_name, kw = "warmup_linear", dict(kw, min_lr=args.lr)
+        elif sched_name == "inverse_sqrt":
+            kw["warmup_steps"] = max(1, args.warmup_steps)
+        if sched_name in ("warmup_linear", "warmup_cosine"):
+            kw["total_steps"] = args.iters
+        lr = _sched.SCHEDULES[sched_name](args.lr, **kw)
+    opt = AdamW(lr=lr, weight_decay=args.weight_decay)
+    train_kw = dict(
+        grad_clip=getattr(args, "grad_clip", 0.0) or None,
+        loss_scale=getattr(args, "loss_scale", None),
+    )
     if single_device:
         engine = engine_cls(
-            model, opt, mesh=make_mesh(devices=[jax.devices()[0]])
+            model, opt, mesh=make_mesh(devices=[jax.devices()[0]]),
+            **train_kw,
         )
         n_dev = 1
     else:
@@ -147,6 +209,7 @@ def run(engine_cls, args, single_device=False):
             pipeline_parallel=getattr(args, "pipeline_parallel", 1),
             pipeline_microbatches=getattr(args, "pipeline_microbatches", 0)
             or None,
+            **train_kw,
         )
         n_dev = engine.n_dev
     if jax.process_index() == 0:

@@ -114,3 +114,21 @@ def test_generate_entry_point(model):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "generated=" in proc.stdout, proc.stdout[-2000:]
+
+
+def test_train_feature_flags():
+    """--lr-schedule/--warmup-steps/--grad-clip/--loss-scale reach the
+    engine from any entry point (schedules, clipping, and AMP are
+    capabilities the reference lacks — reference README.md:68 TODO)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("examples", "zero1", "train.py"),
+         "--cpu-devices", "8", "--iters", "4",
+         "--lr-schedule", "warmup_cosine", "--warmup-steps", "2",
+         "--grad-clip", "1.0", "--loss-scale", "dynamic"],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    losses = _losses(proc.stdout)
+    assert len(losses) == 4
+    import math
+    assert all(map(math.isfinite, losses.values()))

@@ -177,3 +177,120 @@ class TestGenerate:
         assert out.shape == (1, 7)
         np.testing.assert_array_equal(np.asarray(out[:, :3]),
                                       np.asarray(idx))
+
+
+class TestBiasAndDropout:
+    """Reference-parity config knobs (reference example/model.py:23-24).
+    NB the reference's own dropout wiring is dead code — it hard-codes
+    `dropout_p=False` at every call site (model.py:79-81) — so behavior
+    here is what the knob *means*, not what the reference does."""
+
+    CFG = dict(block_size=32, vocab_size=128, n_layer=2, n_head=2,
+               n_embd=32, compute_dtype=jnp.float32)
+
+    def test_bias_false_drops_projection_biases_only(self):
+        m = GPT2Model(GPTConfig(bias=False, **self.CFG))
+        p = m.init(jax.random.PRNGKey(0))
+        for name in ("h.attn.qkv.b", "h.attn.proj.b",
+                     "h.mlp.fc.b", "h.mlp.proj.b"):
+            assert name not in p
+        # layernorm biases stay (reference uses stock nn.LayerNorm)
+        assert "h.ln_1.b" in p and "ln_f.b" in p
+        idx = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+        assert float(m.apply(p, idx, idx)) > 0
+
+    def test_bias_false_trains(self):
+        from tiny_deepspeed_tpu import AdamW, Zero3
+        m = GPT2Model(GPTConfig(bias=False, **self.CFG))
+        eng = Zero3(m, AdamW(lr=1e-3))
+        state = eng.init(jax.random.PRNGKey(0))
+        losses = []
+        for i in range(3):
+            k1, k2 = jax.random.split(jax.random.PRNGKey(100 + i))
+            batch = (jax.random.randint(k1, (8, 32), 0, 128),
+                     jax.random.randint(k2, (8, 32), 0, 128))
+            state, loss = eng.step(state, batch)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_dropout_train_eval_semantics(self):
+        m = GPT2Model(GPTConfig(dropout=0.2, **self.CFG))
+        p = m.init(jax.random.PRNGKey(0))
+        idx = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
+        # eval (no rng): deterministic and identical to a dropout=0 model
+        m0 = GPT2Model(GPTConfig(**self.CFG))
+        assert float(m.apply(p, idx, idx)) == float(m0.apply(p, idx, idx))
+        # train: same key reproduces, different keys differ
+        la = float(m.apply(p, idx, idx, rng=jax.random.PRNGKey(5)))
+        lb = float(m.apply(p, idx, idx, rng=jax.random.PRNGKey(6)))
+        lc = float(m.apply(p, idx, idx, rng=jax.random.PRNGKey(5)))
+        assert la == lc and la != lb
+
+    def test_dropout_engine_trains_and_differs_from_eval(self):
+        from tiny_deepspeed_tpu import AdamW, SingleDevice
+        m = GPT2Model(GPTConfig(dropout=0.1, **self.CFG))
+        m0 = GPT2Model(GPTConfig(**self.CFG))
+        batch = (jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128),
+                 jax.random.randint(jax.random.PRNGKey(2), (8, 32), 0, 128))
+        e1 = SingleDevice(m, AdamW(lr=1e-3))
+        e0 = SingleDevice(m0, AdamW(lr=1e-3))
+        s1, l1 = e1.step(e1.init(jax.random.PRNGKey(0)), batch)
+        s0, l0 = e0.step(e0.init(jax.random.PRNGKey(0)), batch)
+        assert float(l1) != float(l0)  # masks actually applied
+        assert abs(float(l1) - float(l0)) < 1.0  # but sane
+
+    def test_dropout_composes_with_pipeline(self):
+        from tiny_deepspeed_tpu import AdamW, Zero1
+        cfg = dict(self.CFG, n_layer=4, n_embd=64)
+        m = GPT2Model(GPTConfig(dropout=0.1, **cfg))
+        eng = Zero1(m, AdamW(lr=1e-3), pipeline_parallel=2)
+        state = eng.init(jax.random.PRNGKey(0))
+        batch = (jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128),
+                 jax.random.randint(jax.random.PRNGKey(2), (8, 32), 0, 128))
+        state, loss = eng.step(state, batch)
+        assert 0 < float(loss) < 20
+
+    def test_knobs_cover_moe_family(self):
+        """bias/dropout extend to MoEGPT (review r2: the knobs must not be
+        GPT-2-only — MoEConfig inherits them)."""
+        from tiny_deepspeed_tpu import AdamW, MoEConfig, MoEGPT, SingleDevice
+        cfg = MoEConfig(block_size=32, vocab_size=64, n_layer=2, n_head=2,
+                        n_embd=32, n_expert=2, compute_dtype=jnp.float32,
+                        bias=False, dropout=0.2)
+        m = MoEGPT(cfg)
+        p = m.init(jax.random.PRNGKey(0))
+        for name in ("h.attn.qkv.b", "h.attn.proj.b",
+                     "h.moe.fc.b", "h.moe.proj.b"):
+            assert name not in p
+        idx = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64)
+        la = float(m.apply(p, idx, idx, rng=jax.random.PRNGKey(5)))
+        lb = float(m.apply(p, idx, idx, rng=jax.random.PRNGKey(6)))
+        assert la != lb  # masks actually drawn
+        eng = SingleDevice(m, AdamW(lr=1e-3))
+        state = eng.init(jax.random.PRNGKey(0))
+        state, loss = eng.step(state, (idx[:2], idx[:2]))
+        assert 0 < float(loss) < 20
+
+    def test_knobs_cover_llama_family(self):
+        """dropout extends to LlamaModel's residual sites (not just the
+        shared embedding dropout)."""
+        from tiny_deepspeed_tpu import LlamaConfig, LlamaModel
+        cfg = LlamaConfig(block_size=32, vocab_size=64, n_layer=2, n_head=2,
+                          n_embd=32, compute_dtype=jnp.float32, dropout=0.5)
+        m = LlamaModel(cfg)
+        p = m.init(jax.random.PRNGKey(0))
+        idx = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64)
+        # embedding dropout alone cannot explain a per-LAYER key effect:
+        # compare against a model whose blocks ignore dropout_rng by
+        # stripping the keys after setup — losses must differ
+        la = float(m.apply(p, idx, idx, rng=jax.random.PRNGKey(5)))
+        stacked = m.stacked_compute_params(p)
+        x = m.embed(p, idx)
+        stacked2, x2 = m._dropout_setup(stacked, x, jax.random.PRNGKey(5))
+        stacked2.pop("dropout_rng")  # keep embedding dropout only
+        import jax.numpy as _jnp
+        block = m.block_fn(None)
+        y, _ = jax.lax.scan(lambda c, bp: (block(c, bp), None), x2, stacked2)
+        lb = float(m.head(p, y, idx))
+        assert la != lb
+        assert float(m.apply(p, idx, idx)) > 0  # eval path intact

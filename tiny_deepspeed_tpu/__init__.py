@@ -33,7 +33,7 @@ Public API shape mirrors the reference's flat surface
 from .parallel.partition import partition_tensors, materialize_owned
 from .parallel.engine import SingleDevice, DDP, Zero1, Zero2, Zero3
 from .parallel.mesh import make_mesh, init_distributed
-from .optim import SGD, AdamW
+from .optim import SGD, AdamW, schedule
 from .models import (
     GPTConfig, GPT2Model, MoEConfig, MoEGPT, LlamaConfig, LlamaModel,
 )
@@ -61,6 +61,7 @@ __all__ = [
     "init_distributed",
     "SGD",
     "AdamW",
+    "schedule",
     "DDPSGD", "DDPAdamW",
     "Zero1SGD", "Zero1AdamW",
     "Zero2SGD", "Zero2AdamW",

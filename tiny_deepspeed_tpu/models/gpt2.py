@@ -59,6 +59,22 @@ class GPTConfig:
     n_head: int = 12
     n_embd: int = 768
     attn_impl: str = "flash_attention"  # or "standard_attention"
+    # Reference-parity knobs (reference example/model.py:23-24):
+    #  * `bias` gates the four projection biases (attn qkv/proj, mlp fc/
+    #    proj — reference nn.Linear(bias=config.bias)); layernorms keep
+    #    theirs (the reference uses stock nn.LayerNorm) and lm_head is
+    #    always bias-free (reference model.py:137).  The reference DEFAULTS
+    #    bias=False; default True here = the actual GPT-2 architecture.
+    #  * `dropout` in the reference is a dead knob: config.dropout is never
+    #    read, and its attention calls hard-code `dropout_p=False` == 0.0
+    #    (reference model.py:79-81) so dropout never fires even in
+    #    training.  Implemented CORRECTLY here (embedding + post-attention
+    #    + post-MLP residual dropout, inverted scaling); active only when
+    #    a PRNG key is passed to `apply(rng=...)` — the engine does so
+    #    automatically when dropout > 0, deriving a fresh key from the
+    #    optimizer step counter, so eval/generate stay deterministic.
+    bias: bool = True
+    dropout: float = 0.0
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -99,6 +115,15 @@ GPT2_PRESETS: Dict[str, GPTConfig] = {
     "gpt2-774m": GPTConfig(n_layer=36, n_head=20, n_embd=1280),
     "gpt2-1.5b": GPTConfig(n_layer=48, n_head=25, n_embd=1600),
 }
+
+
+def _dropout(x, key, rate: float):
+    """Inverted dropout: zero with prob `rate`, survivors scaled 1/(1-rate)
+    so eval needs no rescaling.  `key` may be a raw (2,) uint32 key row
+    (what a stacked `jax.random.split` yields per layer)."""
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
 
 class GPT2Model:
@@ -161,6 +186,11 @@ class GPT2Model:
             # weight-untied lm_head, like the reference (model.py:136-138)
             "lm_head.w": nrm(next(keys), (d, v), std),
         }
+        if not c.bias:
+            # reference bias=False scope: projection linears only
+            for name in ("h.attn.qkv.b", "h.attn.proj.b",
+                         "h.mlp.fc.b", "h.mlp.proj.b"):
+                del params[name]
         return params
 
     def tp_rules(self) -> Dict[str, int]:
@@ -193,9 +223,12 @@ class GPT2Model:
         master params three times per step: fwd, remat re-fwd, bwd)."""
         c = self.config
         b, t, d = x.shape
+        # dropout rides the stacked tree as a per-layer PRNG key; its
+        # presence (static at trace time) is the train/eval switch
+        dkey = bp.get("dropout_rng")
 
         h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
-        qkv = linear(h, bp["attn.qkv.w"], bp["attn.qkv.b"])
+        qkv = linear(h, bp["attn.qkv.w"], bp.get("attn.qkv.b"))
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(z):  # (B, T, D) -> (B, H, T, Dh)
@@ -205,13 +238,17 @@ class GPT2Model:
             heads(q), heads(k), heads(v), c.attn_impl, pctx
         )
         y = y.swapaxes(1, 2).reshape(b, t, d)
-        y = linear(y, bp["attn.proj.w"], bp["attn.proj.b"])
+        y = linear(y, bp["attn.proj.w"], bp.get("attn.proj.b"))
+        if dkey is not None:
+            y = _dropout(y, jax.random.fold_in(dkey, 0), c.dropout)
         x = x + y
 
         h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
-        h = linear(h, bp["mlp.fc.w"], bp["mlp.fc.b"])
+        h = linear(h, bp["mlp.fc.w"], bp.get("mlp.fc.b"))
         h = jax.nn.gelu(h, approximate=True)
-        h = linear(h, bp["mlp.proj.w"], bp["mlp.proj.b"])
+        h = linear(h, bp["mlp.proj.w"], bp.get("mlp.proj.b"))
+        if dkey is not None:
+            h = _dropout(h, jax.random.fold_in(dkey, 1), c.dropout)
         return x + h
 
     def embed_tokens(self, params, idx):
@@ -270,6 +307,18 @@ class GPT2Model:
             "all": jax.checkpoint_policies.everything_saveable,
         }[self.config.remat_policy]
 
+    def _dropout_setup(self, stacked, x, rng):
+        """Embedding dropout on `x` + one PRNG key per layer into the
+        stacked scan tree (consumed by `_block` via bp["dropout_rng"]).
+        No-op (train==eval) when rng is None or config.dropout == 0.
+        Shared by every model family's apply()."""
+        c = self.config
+        if rng is None or not c.dropout:
+            return stacked, x
+        keys = jax.random.split(rng, c.n_layer + 1)
+        x = _dropout(x, keys[0], c.dropout)
+        return dict(stacked, dropout_rng=keys[1:]), x
+
     def block_fn(self, pctx=None):
         """(x, block_params) -> x, with the configured remat policy applied."""
         def block(x, bp):
@@ -314,15 +363,20 @@ class GPT2Model:
         return logits.astype(jnp.float32)
 
     def apply(self, params, idx, targets: Optional[jax.Array] = None,
-              pctx=None, position=None):
+              pctx=None, position=None, rng=None):
         """Forward pass.  Returns mean loss if targets given, else logits —
         same contract as reference GPT2Model.forward (model.py:139-157).
 
         `pctx` (ParallelContext) makes the forward mesh-aware: activations
         shard (batch over "data", tokens over "seq" when sequence-parallel)
-        and attention dispatches to the sharded kernels."""
+        and attention dispatches to the sharded kernels.
+
+        `rng` (train-time only) enables dropout when config.dropout > 0:
+        one key per layer rides the stacked scan tree, so the same masks
+        are recomputed bit-exactly by the remat backward."""
         x = self.embed(params, idx, pctx)
         stacked = self.stacked_compute_params(params)
+        stacked, x = self._dropout_setup(stacked, x, rng)
         block = self.block_fn(pctx)
 
         if pctx is not None and pctx.pipe_parallel:
@@ -344,8 +398,8 @@ class GPT2Model:
             x, _ = jax.lax.scan(scan_body, x, stacked)
         return self.head(params, x, targets, pctx, position)
 
-    def __call__(self, params, idx, targets=None, pctx=None):
-        return self.apply(params, idx, targets, pctx)
+    def __call__(self, params, idx, targets=None, pctx=None, rng=None):
+        return self.apply(params, idx, targets, pctx, rng=rng)
 
     def generate(self, params, idx, max_new_tokens: int, *,
                  temperature: float = 1.0, top_k: Optional[int] = None,

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from ..ops import linear
 from ..ops.rmsnorm import rmsnorm
 from ..ops.attention import sharded_attention
-from .gpt2 import GPTConfig, GPT2Model
+from .gpt2 import GPTConfig, GPT2Model, _dropout
 
 
 def _round_up(x: int, mult: int) -> int:
@@ -42,7 +42,12 @@ def _round_up(x: int, mult: int) -> int:
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig(GPTConfig):
     """GPTConfig fields reused (block_size, vocab_size, n_layer, n_head,
-    n_embd, attn_impl, dtypes, remat, fused_xent) + Llama knobs."""
+    n_embd, attn_impl, dtypes, remat, fused_xent, dropout) + Llama knobs.
+
+    The inherited `bias` field is IGNORED: the Llama architecture is
+    bias-free by definition (every projection below passes bias=None).
+    `dropout` works exactly as in GPT2Model (post-attention + post-MLP
+    residual dropout + embedding dropout, keyed per step by the engine)."""
 
     n_kv_head: Optional[int] = None     # None -> n_head (MHA)
     rope_theta: float = 10000.0
@@ -181,12 +186,19 @@ class LlamaModel(GPT2Model):
 
         y = sharded_attention(q, k, v, c.attn_impl, pctx)
         y = y.swapaxes(1, 2).reshape(b, t, d)
-        x = x + linear(y, bp["attn.o.w"], None)
+        y = linear(y, bp["attn.o.w"], None)
+        dkey = bp.get("dropout_rng")
+        if dkey is not None:
+            y = _dropout(y, jax.random.fold_in(dkey, 0), c.dropout)
+        x = x + y
 
         h = rmsnorm(x, bp["ln_2.w"])
         gate = jax.nn.silu(linear(h, bp["mlp.gate.w"], None))
         up = linear(h, bp["mlp.up.w"], None)
-        return x + linear(gate * up, bp["mlp.down.w"], None)
+        y = linear(gate * up, bp["mlp.down.w"], None)
+        if dkey is not None:
+            y = _dropout(y, jax.random.fold_in(dkey, 1), c.dropout)
+        return x + y
 
     def final_norm(self, params, x):
         """RMSNorm pre-head (GPT2Model.head's one overridable hook — the

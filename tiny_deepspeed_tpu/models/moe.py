@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from ..ops import linear, layernorm
 from ..ops.attention import sharded_attention
-from .gpt2 import GPTConfig, GPT2Model
+from .gpt2 import GPTConfig, GPT2Model, _dropout
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,7 +75,7 @@ class MoEGPT(GPT2Model):
         def zeros(shape):
             return jnp.zeros(shape, c.param_dtype)
 
-        return {
+        params = {
             "wte": nrm(next(keys), (v, d), std),
             "wpe": nrm(next(keys), (t, d), std),
             "h.ln_1.w": jnp.ones((l, d), c.param_dtype),
@@ -95,6 +95,12 @@ class MoEGPT(GPT2Model):
             "ln_f.b": zeros((d,)),
             "lm_head.w": nrm(next(keys), (d, v), std),
         }
+        if not c.bias:
+            # same scope as GPT2Model: projection biases (attn + experts)
+            for name in ("h.attn.qkv.b", "h.attn.proj.b",
+                         "h.moe.fc.b", "h.moe.proj.b"):
+                del params[name]
+        return params
 
     def tp_rules(self) -> Dict[str, int]:
         return {
@@ -176,9 +182,13 @@ class MoEGPT(GPT2Model):
             xe = jax.lax.with_sharding_constraint(
                 xe, NamedSharding(pctx.mesh, P(pctx.expert_axis, None, None))
             )
-        h = jnp.einsum("ecd,edf->ecf", xe, bp["moe.fc.w"]) + bp["moe.fc.b"][:, None]
+        h = jnp.einsum("ecd,edf->ecf", xe, bp["moe.fc.w"])
+        if "moe.fc.b" in bp:
+            h = h + bp["moe.fc.b"][:, None]
         h = jax.nn.gelu(h, approximate=True)
-        ye = jnp.einsum("ecf,efd->ecd", h, bp["moe.proj.w"]) + bp["moe.proj.b"][:, None]
+        ye = jnp.einsum("ecf,efd->ecd", h, bp["moe.proj.w"])
+        if "moe.proj.b" in bp:
+            ye = ye + bp["moe.proj.b"][:, None]
         y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), ye)
         return y.reshape(b, t, d), aux
 
@@ -186,9 +196,10 @@ class MoEGPT(GPT2Model):
         """Pre-LN block: attention + MoE MLP.  Returns (x, aux)."""
         c = self.config
         b, t, d = x.shape
+        dkey = bp.get("dropout_rng")
 
         h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
-        qkv = linear(h, bp["attn.qkv.w"], bp["attn.qkv.b"])
+        qkv = linear(h, bp["attn.qkv.w"], bp.get("attn.qkv.b"))
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(z):
@@ -196,11 +207,15 @@ class MoEGPT(GPT2Model):
 
         y = sharded_attention(heads(q), heads(k), heads(v), c.attn_impl, pctx)
         y = y.swapaxes(1, 2).reshape(b, t, d)
-        y = linear(y, bp["attn.proj.w"], bp["attn.proj.b"])
+        y = linear(y, bp["attn.proj.w"], bp.get("attn.proj.b"))
+        if dkey is not None:
+            y = _dropout(y, jax.random.fold_in(dkey, 0), c.dropout)
         x = x + y
 
         h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
         y, aux = self._moe_mlp(h, bp, pctx)
+        if dkey is not None:
+            y = _dropout(y, jax.random.fold_in(dkey, 1), c.dropout)
         return x + y, aux
 
     def stacked_compute_params(self, params):
@@ -213,10 +228,11 @@ class MoEGPT(GPT2Model):
         }
 
     def apply(self, params, idx, targets: Optional[jax.Array] = None,
-              pctx=None, position=None):
+              pctx=None, position=None, rng=None):
         c = self.config
         x = self.embed(params, idx, pctx)
         stacked = self.stacked_compute_params(params)
+        stacked, x = self._dropout_setup(stacked, x, rng)
 
         if pctx is not None and pctx.pipe_parallel:
             from ..parallel.pipeline import spmd_pipeline

@@ -13,5 +13,12 @@ parallel/engine.py.
 from .base import Optimizer
 from .sgd import SGD
 from .adamw import AdamW
+from . import schedule
+from .schedule import (
+    SCHEDULES, constant, warmup_linear, warmup_cosine, inverse_sqrt,
+)
 
-__all__ = ["Optimizer", "SGD", "AdamW"]
+__all__ = [
+    "Optimizer", "SGD", "AdamW", "schedule", "SCHEDULES",
+    "constant", "warmup_linear", "warmup_cosine", "inverse_sqrt",
+]

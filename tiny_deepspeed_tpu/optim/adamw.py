@@ -63,6 +63,11 @@ class AdamW(Optimizer):
         if self.amsgrad:
             self._warn_unfused("amsgrad has no Pallas kernel")
             return False
+        if callable(self.lr):
+            # the kernel bakes lr as a static kwarg; a schedule produces a
+            # traced per-step scalar the closure cannot capture
+            self._warn_unfused("lr schedule (kernel takes static lr)")
+            return False
         if self.state_dtype != jnp.float32:
             self._warn_unfused("state_dtype != float32")
             return False
@@ -108,7 +113,7 @@ class AdamW(Optimizer):
         return state
 
     def update_one(self, name, param, grad, state, step):
-        kw = dict(lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
+        kw = dict(lr=self._lr(step), b1=self.b1, b2=self.b2, eps=self.eps,
                   wd=self.weight_decay, decoupled=self.decoupled,
                   maximize=self.maximize)
         if self._use_fused(param):
@@ -156,7 +161,7 @@ class AdamW(Optimizer):
         upd = mhat / (jnp.sqrt(vhat) + self.eps)
         if self.weight_decay and self.decoupled:
             upd = upd + self.weight_decay * p
-        new_p = p - self.lr * upd
+        new_p = p - self._lr(step) * upd
         return new_p.astype(param.dtype), new_state
 
 

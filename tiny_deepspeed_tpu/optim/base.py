@@ -25,10 +25,17 @@ import jax
 
 
 class Optimizer:
-    """Subclasses implement `init_one` and `update_one` per named param."""
+    """Subclasses implement `init_one` and `update_one` per named param.
 
-    def __init__(self, lr: float):
+    `lr` is either a float (the reference's semantics) or a traceable
+    `step -> lr` schedule from optim/schedule.py; `_lr(step)` resolves it
+    at trace time inside the jitted update."""
+
+    def __init__(self, lr):
         self.lr = lr
+
+    def _lr(self, step):
+        return self.lr(step) if callable(self.lr) else self.lr
 
     # -- per-parameter hooks ----------------------------------------------
 

@@ -1,0 +1,84 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Learning-rate schedules: pure, traceable `step -> lr` callables.
+
+The reference hard-codes a constant lr in every example
+(/root/reference/example/ddp/train.py:27) and its optimizers store a float
+(/root/reference/tiny_deepspeed/core/optim/base.py:7-26); real training needs
+warmup + decay.  Any `Optimizer` here accepts either a float `lr` or one of
+these callables — resolution happens at trace time inside the jitted step
+(`Optimizer._lr`), so changing lr per step costs nothing and never re-jits
+(the step counter is already a traced scalar in the optimizer state).
+
+All schedules take and return float32 scalars and use only `jnp` ops, so they
+are safe inside `jit`/`scan`/`shard_map`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def constant(lr: float):
+    """The reference's behavior, as a schedule."""
+    def sched(step):
+        del step
+        return jnp.float32(lr)
+    return sched
+
+
+def warmup_linear(peak_lr: float, total_steps: int, warmup_steps: int = 0,
+                  min_lr: float = 0.0):
+    """Linear ramp 0 -> peak over `warmup_steps`, then linear decay to
+    `min_lr` at `total_steps` (held there after)."""
+    def sched(step):
+        t = step.astype(jnp.float32)
+        warm = t / jnp.maximum(1.0, float(warmup_steps))
+        frac = (t - warmup_steps) / jnp.maximum(
+            1.0, float(total_steps - warmup_steps)
+        )
+        decay = 1.0 - jnp.clip(frac, 0.0, 1.0) * (1.0 - min_lr / peak_lr)
+        return jnp.float32(peak_lr) * jnp.where(
+            t < warmup_steps, jnp.clip(warm, 0.0, 1.0), decay
+        )
+    return sched
+
+
+def warmup_cosine(peak_lr: float, total_steps: int, warmup_steps: int = 0,
+                  min_lr: float = 0.0):
+    """Linear warmup then cosine decay to `min_lr` (the GPT-2/nanoGPT
+    recipe)."""
+    def sched(step):
+        t = step.astype(jnp.float32)
+        warm = t / jnp.maximum(1.0, float(warmup_steps))
+        frac = jnp.clip(
+            (t - warmup_steps)
+            / jnp.maximum(1.0, float(total_steps - warmup_steps)),
+            0.0, 1.0,
+        )
+        cos = min_lr + (peak_lr - min_lr) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * frac)
+        )
+        return jnp.where(
+            t < warmup_steps, jnp.float32(peak_lr) * jnp.clip(warm, 0.0, 1.0),
+            cos,
+        ).astype(jnp.float32)
+    return sched
+
+
+def inverse_sqrt(peak_lr: float, warmup_steps: int = 1):
+    """Noam/transformer schedule: linear warmup, then lr ~ 1/sqrt(step)."""
+    def sched(step):
+        t = jnp.maximum(step.astype(jnp.float32), 1.0)
+        w = float(max(1, warmup_steps))
+        return jnp.float32(peak_lr) * jnp.minimum(t / w, jnp.sqrt(w / t))
+    return sched
+
+
+SCHEDULES = {
+    "constant": constant,
+    "warmup_linear": warmup_linear,
+    "warmup_cosine": warmup_cosine,
+    "inverse_sqrt": inverse_sqrt,
+}

@@ -50,5 +50,5 @@ class SGD(Optimizer):
             )
             new_state = {"velocity": buf.astype(param.dtype)}
             g = g + self.momentum * buf if self.nesterov else buf
-        new_p = p - self.lr * g
+        new_p = p - self._lr(step) * g
         return new_p.astype(param.dtype), new_state

@@ -77,6 +77,10 @@ try:
     class TrainState:
         params: Dict[str, Any]
         opt_state: Dict[str, Any]
+        # dynamic loss-scale state ({"scale": f32, "good": i32}) when the
+        # engine runs with loss_scale="dynamic"; None (no pytree leaves)
+        # otherwise, so existing states/checkpoints keep their structure
+        scaler: Any = None
 except Exception:  # pragma: no cover - flax always present in this image
     TrainState = None
 
@@ -187,6 +191,9 @@ class ZeroEngine:
         expert_parallel: int = 1,
         pipeline_parallel: int = 1,
         pipeline_microbatches: Optional[int] = None,
+        grad_clip: Optional[float] = None,
+        loss_scale=None,
+        loss_scale_growth_interval: int = 2000,
     ):
         """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
         tokens shard over it and attention runs as a ppermute ring
@@ -201,7 +208,19 @@ class ZeroEngine:
         microbatches flow through a GPipe ppermute pipeline
         (parallel/pipeline.py; `pipeline_microbatches` defaults to S).
         All compose with every ZeRO stage (the data axis keeps the ZeRO
-        semantics); all are absent from the reference (SURVEY §2.20)."""
+        semantics); all are absent from the reference (SURVEY §2.20).
+
+        grad_clip: clip gradients to this global L2 norm (computed across
+        every leaf; under ZeRO-2/3 the per-leaf square-sums run on the
+        sharded grads and XLA inserts the psum).  loss_scale: None (off),
+        a float (static scaling), or "dynamic" — scale the loss before
+        backward, unscale grads after; dynamic keeps {scale, good-step
+        count} in TrainState.scaler, halves the scale and SKIPS the
+        optimizer step on non-finite grads, and doubles it after
+        `loss_scale_growth_interval` consecutive finite steps.  This is
+        fp16 AMP (the reference's unchecked TODO, reference README.md:68):
+        bf16 — the TPU default policy — never needs it, fp16
+        (compute_dtype=float16) does."""
         self.model = model
         self.optimizer = optimizer
         pp = int(pipeline_parallel)
@@ -273,6 +292,22 @@ class ZeroEngine:
             seq_impl=seq_impl,
         )
         self.accum_steps = int(accum_steps)
+        # dropout: the model's apply takes rng= when its config declares a
+        # nonzero rate; the step derives a fresh key from the optimizer step
+        # counter so every iteration (and every microbatch) draws new masks
+        # without any state threading or re-jit
+        self._dropout_active = bool(
+            getattr(getattr(model, "config", None), "dropout", 0.0)
+        )
+        self.grad_clip = float(grad_clip) if grad_clip else None
+        if loss_scale is not None and loss_scale != "dynamic" \
+                and not isinstance(loss_scale, (int, float)):
+            raise ValueError(
+                f"loss_scale must be None, a number, or 'dynamic'; "
+                f"got {loss_scale!r}"
+            )
+        self.loss_scale = loss_scale
+        self.loss_scale_growth_interval = int(loss_scale_growth_interval)
         self.n_dev = mesh.devices.size
         # ZeRO sharding happens over the data axis only
         self.n_shard = mesh.shape["data"]
@@ -342,6 +377,11 @@ class ZeroEngine:
             opt_shapes, specs, sharded=self.stage >= 1, base_specs=base
         )
         self._opt_shardings = _to_shardings(opt_specs, mesh)
+        self._scaler_shardings = (
+            {"scale": NamedSharding(mesh, P()),
+             "good": NamedSharding(mesh, P())}
+            if self.loss_scale == "dynamic" else None
+        )
 
         if self.data_parallel:
             batch_spec = P("data", self.seq_axis)  # (B, T): tokens shard too
@@ -365,6 +405,7 @@ class ZeroEngine:
                 TrainState(
                     params=self._param_shardings,
                     opt_state=self._opt_shardings,
+                    scaler=self._scaler_shardings,
                 ),
                 (self._batch_sharding, self._batch_sharding),
             ),
@@ -372,6 +413,7 @@ class ZeroEngine:
                 TrainState(
                     params=self._param_shardings,
                     opt_state=self._opt_shardings,
+                    scaler=self._scaler_shardings,
                 ),
                 NamedSharding(self.mesh, P()),
             ),
@@ -416,7 +458,14 @@ class ZeroEngine:
         opt_state = jax.jit(
             self.optimizer.init, out_shardings=self._opt_shardings
         )(params)
-        return TrainState(params=params, opt_state=opt_state)
+        scaler = None
+        if self.loss_scale == "dynamic":
+            scaler = jax.device_put(
+                {"scale": jnp.float32(2.0 ** 15),
+                 "good": jnp.zeros((), jnp.int32)},
+                self._scaler_shardings,
+            )
+        return TrainState(params=params, opt_state=opt_state, scaler=scaler)
 
     # -- the train step ----------------------------------------------------
 
@@ -429,12 +478,30 @@ class ZeroEngine:
     def _step_impl(self, state: "TrainState", batch):
         idx, targets = batch
         params = state.params
+        dynamic = self.loss_scale == "dynamic"
+        if dynamic:
+            scale = state.scaler["scale"]
+        elif self.loss_scale:
+            scale = jnp.float32(self.loss_scale)
+        else:
+            scale = None
 
-        def loss_fn(p, ix, tg):
-            return self.model.apply(p, ix, tg, pctx=self.pctx)
+        rng = (
+            jax.random.fold_in(jax.random.PRNGKey(0xD0), state.opt_state["step"])
+            if self._dropout_active else None
+        )
+
+        def loss_fn(p, ix, tg, rng=None):
+            kw = {"rng": rng} if rng is not None else {}
+            l = self.model.apply(p, ix, tg, pctx=self.pctx, **kw)
+            # loss scaling happens INSIDE the differentiated fn so the
+            # whole backward runs on scaled values (fp16 AMP)
+            return l * scale if scale is not None else l
 
         if self.accum_steps == 1:
-            loss, grads = jax.value_and_grad(loss_fn)(params, idx, targets)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, idx, targets, rng
+            )
         else:
             # Microbatch accumulation: batch is (accum, B, T); grads summed
             # locally across microbatches, collective cost paid once — the
@@ -442,8 +509,10 @@ class ZeroEngine:
             # (ddp/wrapper.py:25-33) as explicit loop semantics.
             def body(carry, mb):
                 acc_loss, acc_grads = carry
-                ix, tg = mb
-                l, g = jax.value_and_grad(loss_fn)(params, ix, tg)
+                ix, tg, mb_i = mb
+                mb_rng = (jax.random.fold_in(rng, mb_i)
+                          if rng is not None else None)
+                l, g = jax.value_and_grad(loss_fn)(params, ix, tg, mb_rng)
                 acc_grads = jax.tree.map(
                     lambda a, b: a + b.astype(jnp.float32), acc_grads, g
                 )
@@ -466,13 +535,38 @@ class ZeroEngine:
                     zero_grads, self._shard_shardings
                 )
             (loss, grads), _ = jax.lax.scan(
-                body, (jnp.zeros((), jnp.float32), zero_grads), (idx, targets)
+                body, (jnp.zeros((), jnp.float32), zero_grads),
+                (idx, targets, jnp.arange(self.accum_steps)),
             )
             loss = loss / self.accum_steps
             grads = jax.tree.map(
                 lambda g, p: (g / self.accum_steps).astype(p.dtype),
                 grads, params,
             )
+
+        def _rescale(tree, factor):
+            return jax.tree.map(
+                lambda g: (g.astype(jnp.float32) * factor).astype(g.dtype),
+                tree,
+            )
+
+        if scale is not None:
+            loss = loss / scale
+            grads = _rescale(grads, 1.0 / scale)
+        if dynamic:
+            # finiteness judged on the UNSCALED grads, before clipping can
+            # turn an inf norm into nans
+            finite = jnp.bool_(True)
+            for g in jax.tree.leaves(grads):
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        if self.grad_clip is not None:
+            gsq = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            )
+            grads = _rescale(grads, jnp.minimum(
+                1.0, self.grad_clip / (jnp.sqrt(gsq) + 1e-6)
+            ))
 
         if self.stage >= 2:
             # ZeRO-2/3: gradient sharding — the all-reduce XLA would emit for
@@ -482,11 +576,39 @@ class ZeroEngine:
         new_params, new_opt = self.optimizer.update(
             params, grads, state.opt_state
         )
+        new_scaler = state.scaler
+        if dynamic:
+            # overflow -> discard the whole update (params, moments, AND the
+            # step counter: a skipped step must not advance bias correction),
+            # halve the scale; grow it after `growth_interval` clean steps
+            def _sel(new, old):
+                return jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o.astype(n.dtype)),
+                    new, old,
+                )
+            new_params = _sel(new_params, params)
+            new_opt = _sel(new_opt, state.opt_state)
+            good = state.scaler["good"] + 1
+            grow = good >= self.loss_scale_growth_interval
+            new_scaler = {
+                "scale": jnp.where(
+                    finite,
+                    jnp.where(grow, scale * 2.0, scale),
+                    jnp.maximum(scale * 0.5, 1.0),
+                ),
+                "good": jnp.where(
+                    jnp.logical_and(finite, jnp.logical_not(grow)), good, 0
+                ).astype(jnp.int32),
+            }
         # ZeRO-1/2: updated params all-gather back to replicated; ZeRO-3:
         # they stay sharded.  (The reference broadcasts per-param from the
         # owner in a python loop with no bucketing, zero1/optim.py:25-34.)
         new_params = self._constrain(new_params, self._param_shardings)
-        return TrainState(params=new_params, opt_state=new_opt), loss
+        return (
+            TrainState(params=new_params, opt_state=new_opt,
+                       scaler=new_scaler),
+            loss,
+        )
 
     def step(self, state, batch):
         """One optimizer step.  batch = (idx, targets), each (B, T) int32 —

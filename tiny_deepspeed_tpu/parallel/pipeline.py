@@ -128,6 +128,15 @@ def spmd_pipeline(
                 xmb, jnp.clip(t, 0, m - 1), 0, keepdims=False
             )
             state = jnp.where(stage == 0, inj, state)
+            loc = stacked_loc
+            if isinstance(loc, dict) and "dropout_rng" in loc:
+                # a stage sees every microbatch with the same per-layer key;
+                # fold the tick in so microbatches draw independent masks
+                # (the plain-scan path covers the whole batch with one mask
+                # draw per layer, so there this is unnecessary)
+                loc = dict(loc, dropout_rng=jax.vmap(
+                    lambda kk: jax.random.fold_in(kk, t)
+                )(loc["dropout_rng"]))
 
             def layer(c, bp):
                 if with_aux:
@@ -138,7 +147,7 @@ def spmd_pipeline(
 
             if with_aux:
                 (state, aux_tick), _ = jax.lax.scan(
-                    layer, (state, jnp.zeros((), jnp.float32)), stacked_loc
+                    layer, (state, jnp.zeros((), jnp.float32)), loc
                 )
                 # this stage holds microbatch j = t - stage; bubble ticks
                 # (j outside [0, m)) process zeros — their aux is noise
@@ -147,7 +156,7 @@ def spmd_pipeline(
                     (j >= 0) & (j < m), aux_tick, 0.0
                 )
             else:
-                state, _ = jax.lax.scan(layer, state, stacked_loc)
+                state, _ = jax.lax.scan(layer, state, loc)
             out = state
             state = jax.lax.ppermute(state, pipe_axis, shift)
             return (state, aux_acc), out

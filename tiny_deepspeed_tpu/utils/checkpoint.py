@@ -77,6 +77,7 @@ def load_checkpoint(directory: str, engine=None, step: Optional[int] = None,
         shardings = TrainState(
             params=engine._param_shardings,
             opt_state=engine._opt_shardings,
+            scaler=engine._scaler_shardings,
         )
         target = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
