@@ -294,3 +294,76 @@ class TestBiasAndDropout:
         lb = float(m.head(p, y, idx))
         assert la != lb
         assert float(m.apply(p, idx, idx)) > 0  # eval path intact
+
+
+class TestKVCacheDecode:
+    """generate(use_cache=True): prefill + single-position cached decode.
+    Greedy outputs must EQUAL the uncached full-forward path — the cache is
+    an execution strategy, not a semantic change."""
+
+    CFG = dict(block_size=64, vocab_size=128, n_layer=2, n_head=2,
+               n_embd=32, compute_dtype=jnp.float32)
+
+    def _greedy_both(self, m, vocab=128, t0=7, new=12):
+        p = m.init(jax.random.PRNGKey(0))
+        idx = jax.random.randint(jax.random.PRNGKey(1), (2, t0), 0, vocab)
+        a = m.generate(p, idx, new, temperature=0.0, use_cache=False)
+        b = m.generate(p, idx, new, temperature=0.0, use_cache=True)
+        return np.asarray(a), np.asarray(b)
+
+    def test_gpt2_cached_equals_uncached(self):
+        a, b = self._greedy_both(GPT2Model(GPTConfig(**self.CFG)))
+        np.testing.assert_array_equal(a, b)
+
+    def test_gpt2_nobias_cached_equals_uncached(self):
+        a, b = self._greedy_both(
+            GPT2Model(GPTConfig(bias=False, **self.CFG))
+        )
+        np.testing.assert_array_equal(a, b)
+
+    def test_moe_cached_equals_uncached(self):
+        from tiny_deepspeed_tpu import MoEConfig, MoEGPT
+        cfg = MoEConfig(n_expert=2, **self.CFG)
+        a, b = self._greedy_both(MoEGPT(cfg))
+        np.testing.assert_array_equal(a, b)
+
+    def test_llama_gqa_cached_equals_uncached(self):
+        from tiny_deepspeed_tpu import LlamaConfig, LlamaModel
+        cfg = LlamaConfig(block_size=64, vocab_size=128, n_layer=2,
+                          n_head=4, n_kv_head=2, n_embd=32,
+                          compute_dtype=jnp.float32)
+        a, b = self._greedy_both(LlamaModel(cfg))
+        np.testing.assert_array_equal(a, b)
+
+    def test_sampled_decode_runs_and_caches_jit(self):
+        m = GPT2Model(GPTConfig(**self.CFG))
+        p = m.init(jax.random.PRNGKey(0))
+        idx = jnp.array([[1, 2, 3]], jnp.int32)
+        out = m.generate(p, idx, 5, temperature=0.8, top_k=20,
+                         key=jax.random.PRNGKey(7))
+        assert out.shape == (1, 8)
+        n = len(m._generate_cache)
+        m.generate(p, idx, 5, temperature=0.8, top_k=20,
+                   key=jax.random.PRNGKey(8))
+        assert len(m._generate_cache) == n  # same shapes -> no new trace
+
+    def test_moe_many_experts_small_batch(self):
+        """Review r2: decode routes S=B tokens, so the train-time capacity
+        formula would collapse to 1 slot at E=8/B=2; the decode path uses
+        the drop-free S*k capacity instead.  Bit-equality with the uncached
+        path is NOT expected here — the full-sequence path's static
+        capacity drops over-capacity tokens that drop-free decode keeps
+        (inherent to GShard routing; the generate() docstring scopes the
+        equality claim accordingly).  The invariants that DO hold: the
+        prompt is preserved, decode is deterministic, and tokens are in
+        range."""
+        from tiny_deepspeed_tpu import MoEConfig, MoEGPT
+        cfg = MoEConfig(n_expert=8, expert_top_k=2, **self.CFG)
+        m = MoEGPT(cfg)
+        p = m.init(jax.random.PRNGKey(0))
+        idx = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0, 128)
+        a = np.asarray(m.generate(p, idx, 12, temperature=0.0))
+        b = np.asarray(m.generate(p, idx, 12, temperature=0.0))
+        np.testing.assert_array_equal(a, b)  # deterministic
+        np.testing.assert_array_equal(a[:, :7], np.asarray(idx))
+        assert ((0 <= a) & (a < 128)).all()

@@ -216,11 +216,13 @@ class GPT2Model:
 
     # -- forward -----------------------------------------------------------
 
-    def _block(self, x, bp, pctx=None):
+    def _block(self, x, bp, pctx=None, return_kv=False):
         """One pre-LN transformer block. x: (B, T, D) in compute_dtype;
         bp: this block's params, already in compute_dtype (pre-cast once in
         `apply` — casting per-layer inside the scan re-reads the float32
-        master params three times per step: fwd, remat re-fwd, bwd)."""
+        master params three times per step: fwd, remat re-fwd, bwd).
+        return_kv additionally returns this layer's (k, v) head tensors —
+        the KV-cache prefill hook (`_prefill`)."""
         c = self.config
         b, t, d = x.shape
         # dropout rides the stacked tree as a per-layer PRNG key; its
@@ -234,9 +236,8 @@ class GPT2Model:
         def heads(z):  # (B, T, D) -> (B, H, T, Dh)
             return z.reshape(b, t, c.n_head, c.head_dim).swapaxes(1, 2)
 
-        y = sharded_attention(
-            heads(q), heads(k), heads(v), c.attn_impl, pctx
-        )
+        kh, vh = heads(k), heads(v)
+        y = sharded_attention(heads(q), kh, vh, c.attn_impl, pctx)
         y = y.swapaxes(1, 2).reshape(b, t, d)
         y = linear(y, bp["attn.proj.w"], bp.get("attn.proj.b"))
         if dkey is not None:
@@ -249,7 +250,150 @@ class GPT2Model:
         h = linear(h, bp["mlp.proj.w"], bp.get("mlp.proj.b"))
         if dkey is not None:
             h = _dropout(h, jax.random.fold_in(dkey, 1), c.dropout)
-        return x + h
+        x = x + h
+        return (x, (kh, vh)) if return_kv else x
+
+    # -- KV-cache decode ---------------------------------------------------
+    #
+    # generate(use_cache=False) re-runs the FULL (B, block_size) forward per
+    # sampled token: O(L * T^2) attention per token.  The cached path runs
+    # the prompt once ("prefill", which also emits every layer's K/V head
+    # tensors), then each new token is one (B, 1, D) pass attending to the
+    # cache — O(L * T) per token, the standard inference structure.  The
+    # reference never needed either: its model only trains (SURVEY §2.1).
+
+    def _decode_attention(self, q, ck, cv, pos):
+        """q: (B, Hq, 1, Dh); ck/cv: (B, Hkv, T, Dh) caches; pos: the
+        query's position (cache filled through pos).  Full-length masked
+        attention — slots past pos are zero padding, masked out.  GQA
+        (Hq > Hkv) groups query heads per KV head instead of materializing
+        a repeated cache."""
+        b, hq, _, dh = q.shape
+        hkv = ck.shape[1]
+        qf = q.astype(jnp.float32) * (1.0 / math.sqrt(dh))
+        ckf, cvf = ck.astype(jnp.float32), cv.astype(jnp.float32)
+        mask = jnp.arange(ck.shape[2]) <= pos
+        if hq != hkv:
+            g = hq // hkv
+            att = jnp.einsum("bkgd,bktd->bkgt", qf.reshape(b, hkv, g, dh),
+                             ckf)
+            att = jnp.where(mask[None, None, None], att, -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1)
+            y = jnp.einsum("bkgt,bktd->bkgd", att, cvf)
+            y = y.reshape(b, hq, 1, dh)
+        else:
+            att = jnp.einsum("bhqd,bhtd->bhqt", qf, ckf)
+            att = jnp.where(mask[None, None, None], att, -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1)
+            y = jnp.einsum("bhqt,bhtd->bhqd", att, cvf)
+        return y.astype(q.dtype)
+
+    def _attn_decode(self, x, bp, ck, cv, pos):
+        """Attention half of one decode step: write this position's K/V
+        into the cache, attend, residual-add.  x: (B, 1, D)."""
+        c = self.config
+        b = x.shape[0]
+        h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
+        qkv = linear(h, bp["attn.qkv.w"], bp.get("attn.qkv.b"))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads1(z):
+            return z.reshape(b, 1, c.n_head, c.head_dim).swapaxes(1, 2)
+
+        ck = jax.lax.dynamic_update_slice(
+            ck, heads1(k).astype(ck.dtype), (0, 0, pos, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, heads1(v).astype(cv.dtype), (0, 0, pos, 0)
+        )
+        y = self._decode_attention(heads1(q), ck, cv, pos)
+        y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
+        y = linear(y, bp["attn.proj.w"], bp.get("attn.proj.b"))
+        return x + y, ck, cv
+
+    def _block_decode(self, x, bp, ck, cv, pos):
+        """One block, one token: cached attention + MLP."""
+        x, ck, cv = self._attn_decode(x, bp, ck, cv, pos)
+        h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
+        h = linear(h, bp["mlp.fc.w"], bp.get("mlp.fc.b"))
+        h = jax.nn.gelu(h, approximate=True)
+        h = linear(h, bp["mlp.proj.w"], bp.get("mlp.proj.b"))
+        return x + h, ck, cv
+
+    def _prefill_body(self, x, bp):
+        """Scan body for the prompt pass: (x, (k, v)).  Families whose
+        _block returns extra values (MoE aux) override this to discard
+        them."""
+        return self._block(x, bp, None, return_kv=True)
+
+    def _prefill(self, params, idx, cache_len, stacked=None):
+        """Run the prompt, returning final-position logits (B, V) float32
+        plus (L, B, Hkv, cache_len, Dh) K/V caches (prompt prefix filled,
+        rest zeros)."""
+        x = self.embed(params, idx)
+        if stacked is None:
+            stacked = self.stacked_compute_params(params)
+        x, (ks, vs) = jax.lax.scan(self._prefill_body, x, stacked)
+        pad = ((0, 0), (0, 0), (0, 0), (0, cache_len - idx.shape[1]), (0, 0))
+        return self.head(params, x)[:, 0], jnp.pad(ks, pad), jnp.pad(vs, pad)
+
+    def _decode_blocks(self, stacked, x, ks, vs, pos):
+        def body(x, layer):
+            bp, ck, cv = layer
+            xo, ck, cv = self._block_decode(x, bp, ck, cv, pos)
+            return xo, (ck, cv)
+
+        x, (ks, vs) = jax.lax.scan(body, x, (stacked, ks, vs))
+        return x, ks, vs
+
+    def _embed_decode(self, params, tok, pos):
+        """One token at one position -> (B, 1, D).  tok: (B,) ints."""
+        x = self.embed_tokens(params, tok[:, None])
+        return x + jax.lax.dynamic_slice_in_dim(
+            params["wpe"], pos, 1, 0
+        )[None].astype(x.dtype)
+
+    @staticmethod
+    def _sample(logit, key, temperature, top_k):
+        """(B, V) float32 logits -> (B,) int32 next tokens."""
+        if top_k is not None:
+            kth = jax.lax.top_k(logit, top_k)[0][:, -1:]
+            logit = jnp.where(logit < kth, -jnp.inf, logit)
+        if temperature == 0.0:
+            return jnp.argmax(logit, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logit / temperature
+        ).astype(jnp.int32)
+
+    def _generate_impl_cached(self, params, idx, key, *, t0, max_new_tokens,
+                              temperature, top_k):
+        total = t0 + max_new_tokens
+        b = idx.shape[0]
+        buf = jnp.zeros((b, total), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, idx.astype(jnp.int32), (0, 0))
+        if max_new_tokens == 0:
+            return buf
+        stacked = self.stacked_compute_params(params)
+        logits, ks, vs = self._prefill(params, idx, total, stacked)
+
+        def body(i, carry):
+            buf, ks, vs, logits, key = carry
+            key, sub = jax.random.split(key)
+            nxt = self._sample(logits, sub, temperature, top_k)
+            buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+            x = self._embed_decode(params, nxt, i)
+            x, ks, vs = self._decode_blocks(stacked, x, ks, vs, i)
+            logits = self.head(params, x)[:, 0]
+            return buf, ks, vs, logits, key
+
+        # N-1 decode iterations; the final token needs only a sample, not
+        # another L-layer pass whose logits nobody reads
+        buf, ks, vs, logits, key = jax.lax.fori_loop(
+            t0, total - 1, body, (buf, ks, vs, logits, key)
+        )
+        key, sub = jax.random.split(key)
+        last = self._sample(logits, sub, temperature, top_k)
+        return jax.lax.dynamic_update_slice(buf, last[:, None], (0, total - 1))
 
     def embed_tokens(self, params, idx):
         """wte gather (+ optional row-norm cap) -> (B, T, D) compute dtype.
@@ -403,19 +547,23 @@ class GPT2Model:
 
     def generate(self, params, idx, max_new_tokens: int, *,
                  temperature: float = 1.0, top_k: Optional[int] = None,
-                 key=None):
+                 key=None, use_cache: bool = True):
         """Autoregressive sampling: (B, T0) prompt -> (B, T0+max_new_tokens).
 
         The reference has no sampling loop (its model only trains); this is
         the capability users expect from a GPT training framework.  TPU-first
-        shape discipline: the token buffer is a FIXED (B, block_size) array
-        updated in place and the decode loop is a `lax.fori_loop` inside one
-        cached jit (keyed on shapes + sampling settings, so repeat calls
-        don't retrace); causal attention makes the zero-padded future
-        positions inert, and each step projects only the single position it
-        samples from (`head(position=...)`).  temperature=0 gives greedy
-        decoding and needs no key; stochastic sampling requires an explicit
-        PRNG key (no silent fixed seed).
+        shape discipline: the token buffer is a FIXED-shape array updated in
+        place and the decode loop is a `lax.fori_loop` inside one cached jit
+        (keyed on shapes + sampling settings, so repeat calls don't
+        retrace).  use_cache=True (default) decodes with a per-layer KV
+        cache: prompt prefill + one (B, 1, D) pass per token, O(L*T) not
+        O(L*T^2) — greedy outputs are bit-checked equal to the uncached
+        full-forward path (tests/test_model.py; for MoE the equality holds
+        whenever expert capacity overflows in neither path — the
+        full-sequence path's static capacity can drop tokens the drop-free
+        decode keeps, models/moe.py).  temperature=0 gives
+        greedy decoding and needs no key; stochastic sampling requires an
+        explicit PRNG key (no silent fixed seed).
         """
         c = self.config
         b, t0 = idx.shape
@@ -433,7 +581,7 @@ class GPT2Model:
                 )
             key = jax.random.PRNGKey(0)  # unused by the greedy path
 
-        cache_key = (b, t0, max_new_tokens, temperature, top_k)
+        cache_key = (b, t0, max_new_tokens, temperature, top_k, use_cache)
         fn = self._generate_cache.get(cache_key)
         if fn is None:
             # bounded LRU: each entry pins a jitted executable on the model
@@ -441,9 +589,11 @@ class GPT2Model:
             # combinations would leak compiled programs (ADVICE r1)
             if len(self._generate_cache) >= 32:
                 self._generate_cache.pop(next(iter(self._generate_cache)))
+            impl = (self._generate_impl_cached if use_cache
+                    else self._generate_impl)
             fn = jax.jit(
                 partial(
-                    self._generate_impl, t0=t0,
+                    impl, t0=t0,
                     max_new_tokens=max_new_tokens,
                     temperature=temperature, top_k=top_k,
                 )
@@ -465,16 +615,8 @@ class GPT2Model:
         def body(i, carry):
             buf, key = carry
             logit = self.apply(params, buf, position=i - 1)[:, 0]  # (B, V)
-            if top_k is not None:
-                kth = jax.lax.top_k(logit, top_k)[0][:, -1:]
-                logit = jnp.where(logit < kth, -jnp.inf, logit)
             key, sub = jax.random.split(key)
-            if temperature == 0.0:
-                nxt = jnp.argmax(logit, axis=-1).astype(jnp.int32)
-            else:
-                nxt = jax.random.categorical(
-                    sub, logit / temperature
-                ).astype(jnp.int32)
+            nxt = self._sample(logit, sub, temperature, top_k)
             buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
             return buf, key
 

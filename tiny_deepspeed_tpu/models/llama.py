@@ -162,7 +162,7 @@ class LlamaModel(GPT2Model):
             pos = pos + jax.lax.axis_index(pctx.seq_axis) * t_local
         return pos
 
-    def _block(self, x, bp, pctx=None):
+    def _block(self, x, bp, pctx=None, return_kv=False):
         c = self.config
         b, t, d = x.shape
         hd = c.head_dim
@@ -179,6 +179,7 @@ class LlamaModel(GPT2Model):
         pos = self._positions(t, pctx)
         q = rope(q, pos, c.rope_theta)
         k = rope(k, pos, c.rope_theta)
+        kv = (k, v)  # cached UNREPEATED (post-rope): decode groups q heads
         if nkv != nq:  # GQA: repeat K/V heads up to the query head count
             rep = nq // nkv
             k = jnp.repeat(k, rep, axis=1)
@@ -198,7 +199,46 @@ class LlamaModel(GPT2Model):
         y = linear(gate * up, bp["mlp.down.w"], None)
         if dkey is not None:
             y = _dropout(y, jax.random.fold_in(dkey, 1), c.dropout)
-        return x + y
+        x = x + y
+        return (x, kv) if return_kv else x
+
+    # -- KV-cache decode (GPT2Model machinery; Llama attention/MLP) --------
+
+    def _attn_decode(self, x, bp, ck, cv, pos):
+        c = self.config
+        b = x.shape[0]
+        hd = c.head_dim
+        h = rmsnorm(x, bp["ln_1.w"])
+        q = linear(h, bp["attn.q.w"], None)
+        k = linear(h, bp["attn.k.w"], None)
+        v = linear(h, bp["attn.v.w"], None)
+        q = q.reshape(b, 1, c.n_head, hd).swapaxes(1, 2)
+        k = k.reshape(b, 1, c.kv_heads, hd).swapaxes(1, 2)
+        v = v.reshape(b, 1, c.kv_heads, hd).swapaxes(1, 2)
+        p1 = jnp.reshape(pos, (1,))
+        q = rope(q, p1, c.rope_theta)
+        k = rope(k, p1, c.rope_theta)
+        ck = jax.lax.dynamic_update_slice(
+            ck, k.astype(ck.dtype), (0, 0, pos, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, v.astype(cv.dtype), (0, 0, pos, 0)
+        )
+        y = self._decode_attention(q, ck, cv, pos)
+        y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
+        return x + linear(y, bp["attn.o.w"], None), ck, cv
+
+    def _block_decode(self, x, bp, ck, cv, pos):
+        x, ck, cv = self._attn_decode(x, bp, ck, cv, pos)
+        h = rmsnorm(x, bp["ln_2.w"])
+        gate = jax.nn.silu(linear(h, bp["mlp.gate.w"], None))
+        up = linear(h, bp["mlp.up.w"], None)
+        return x + linear(gate * up, bp["mlp.down.w"], None), ck, cv
+
+    def _embed_decode(self, params, tok, pos):
+        """No wpe table — position enters via RoPE inside each block."""
+        del pos
+        return self.embed_tokens(params, tok[:, None])
 
     def final_norm(self, params, x):
         """RMSNorm pre-head (GPT2Model.head's one overridable hook — the

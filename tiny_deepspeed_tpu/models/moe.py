@@ -124,17 +124,21 @@ class MoEGPT(GPT2Model):
 
     # -- routing -----------------------------------------------------------
 
-    def _route(self, x, router_w):
+    def _route(self, x, router_w, capacity=None):
         """Top-k dispatch/combine tensors.  x: (S, D) float32 router input.
 
         Returns (dispatch (S,E,C) bool-ish, combine (S,E,C), aux scalar).
         Static capacity C = cf * k * S / E; overflow tokens drop (standard
         GShard semantics — the residual stream still carries them).
+        `capacity` overrides the formula (the decode path passes the
+        drop-free bound S*k: at one position S is tiny, so the train-time
+        formula would collapse to ~1 slot and drop tokens the full-sequence
+        path keeps).
         """
         c = self.config
         s = x.shape[0]
         e, k = c.n_expert, c.expert_top_k
-        cap = max(1, int(c.capacity_factor * k * s / e))
+        cap = capacity or max(1, int(c.capacity_factor * k * s / e))
 
         logits = jnp.einsum(
             "sd,de->se", x, router_w, preferred_element_type=jnp.float32
@@ -166,13 +170,14 @@ class MoEGPT(GPT2Model):
 
     # -- forward -----------------------------------------------------------
 
-    def _moe_mlp(self, x, bp, pctx=None):
+    def _moe_mlp(self, x, bp, pctx=None, capacity=None):
         """x: (B, T, D) -> (B, T, D), plus aux loss."""
         c = self.config
         b, t, d = x.shape
         xs = x.reshape(b * t, d)
         dispatch, combine, aux = self._route(
-            xs.astype(jnp.float32), bp["moe.router.w"].astype(jnp.float32)
+            xs.astype(jnp.float32), bp["moe.router.w"].astype(jnp.float32),
+            capacity=capacity,
         )
         dispatch = dispatch.astype(x.dtype)
         # (S,E,C) x (S,D) -> (E,C,D): the all-to-all boundary under EP
@@ -192,7 +197,7 @@ class MoEGPT(GPT2Model):
         y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), ye)
         return y.reshape(b, t, d), aux
 
-    def _block(self, x, bp, pctx=None):
+    def _block(self, x, bp, pctx=None, return_kv=False):
         """Pre-LN block: attention + MoE MLP.  Returns (x, aux)."""
         c = self.config
         b, t, d = x.shape
@@ -205,7 +210,8 @@ class MoEGPT(GPT2Model):
         def heads(z):
             return z.reshape(b, t, c.n_head, c.head_dim).swapaxes(1, 2)
 
-        y = sharded_attention(heads(q), heads(k), heads(v), c.attn_impl, pctx)
+        kh, vh = heads(k), heads(v)
+        y = sharded_attention(heads(q), kh, vh, c.attn_impl, pctx)
         y = y.swapaxes(1, 2).reshape(b, t, d)
         y = linear(y, bp["attn.proj.w"], bp.get("attn.proj.b"))
         if dkey is not None:
@@ -216,7 +222,30 @@ class MoEGPT(GPT2Model):
         y, aux = self._moe_mlp(h, bp, pctx)
         if dkey is not None:
             y = _dropout(y, jax.random.fold_in(dkey, 1), c.dropout)
-        return x + y, aux
+        x = x + y
+        return ((x, aux), (kh, vh)) if return_kv else (x, aux)
+
+    def _prefill_body(self, x, bp):
+        """KV-cache prompt pass: aux loss is a training quantity — dropped
+        at inference."""
+        (x, _aux), kv = self._block(x, bp, None, return_kv=True)
+        return x, kv
+
+    def _block_decode(self, x, bp, ck, cv, pos):
+        """Cached attention (GPT2Model._attn_decode) + routed experts on
+        the single position, with DROP-FREE capacity S*k (the train-time
+        cf*k*S/E formula collapses to ~1 slot at S=B and would drop tokens
+        the full-sequence path keeps).  NB the uncached path can still drop
+        an over-capacity token the decode path keeps — inherent to
+        static-capacity GShard routing; equality holds whenever neither
+        path overflows."""
+        x, ck, cv = self._attn_decode(x, bp, ck, cv, pos)
+        h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
+        s = x.shape[0]  # one position: S = B tokens routed together
+        y, _aux = self._moe_mlp(
+            h, bp, None, capacity=s * self.config.expert_top_k
+        )
+        return x + y, ck, cv
 
     def stacked_compute_params(self, params):
         """Like GPT2Model's, but router weights stay float32: routing logits
