@@ -33,6 +33,10 @@ def main():
     p.add_argument("--batch", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--cpu", action="store_true", help="force CPU backend")
+    p.add_argument("--no-cache", action="store_true",
+                   help="decode with the full forward per token instead of "
+                        "the KV cache (cross-check / debugging; greedy "
+                        "outputs match the cached path)")
     args = p.parse_args()
 
     if args.cpu:
@@ -57,15 +61,26 @@ def main():
     prompt = jax.random.randint(
         key, (args.batch, args.prompt_len), 0, cfg.vocab_size, jnp.int32
     )
-    out = model.generate(
+    import time
+    gen = lambda: model.generate(
         params, prompt, args.max_new_tokens,
         temperature=args.temperature, top_k=args.top_k,
         key=jax.random.PRNGKey(args.seed + 1),
+        use_cache=not args.no_cache,
     )
+    out = gen()  # first call compiles
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = gen()
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
     for row in out:
         toks = [int(t) for t in row]
         print(f"prompt={toks[:args.prompt_len]} -> "
               f"generated={toks[args.prompt_len:]}")
+    n = args.batch * args.max_new_tokens
+    print(f"decode ({'full forward' if args.no_cache else 'KV cache'}): "
+          f"{n / dt:.0f} tokens/s")
 
 
 if __name__ == "__main__":

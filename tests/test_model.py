@@ -367,3 +367,58 @@ class TestKVCacheDecode:
         np.testing.assert_array_equal(a, b)  # deterministic
         np.testing.assert_array_equal(a[:, :7], np.asarray(idx))
         assert ((0 <= a) & (a < 128)).all()
+
+
+class TestWeightTying:
+    """tie_weights=True: lm_head projects through wte.T (actual GPT-2 ties;
+    the reference unties, model.py:136-138, so False is the default)."""
+
+    CFG = dict(block_size=64, vocab_size=128, n_layer=2, n_head=2,
+               n_embd=32, compute_dtype=jnp.float32)
+
+    @pytest.mark.parametrize("family", ["gpt2", "moe", "llama"])
+    def test_tied_param_set_and_training(self, family):
+        from tiny_deepspeed_tpu import (
+            AdamW, LlamaConfig, LlamaModel, MoEConfig, MoEGPT, SingleDevice,
+        )
+        if family == "gpt2":
+            m = GPT2Model(GPTConfig(tie_weights=True, **self.CFG))
+        elif family == "moe":
+            m = MoEGPT(MoEConfig(tie_weights=True, n_expert=2, **self.CFG))
+        else:
+            m = LlamaModel(LlamaConfig(tie_weights=True, **self.CFG))
+        p = m.init(jax.random.PRNGKey(0))
+        assert "lm_head.w" not in p
+        eng = SingleDevice(m, AdamW(lr=1e-3))
+        state = eng.init(jax.random.PRNGKey(0))
+        # fixed batch: loss must drop when stepping on the same data
+        k1, k2 = jax.random.split(jax.random.PRNGKey(100))
+        batch = (jax.random.randint(k1, (8, 64), 0, 128),
+                 jax.random.randint(k2, (8, 64), 0, 128))
+        losses = []
+        for _ in range(4):
+            state, loss = eng.step(state, batch)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_tied_saves_params_and_generates(self):
+        untied = GPT2Model(GPTConfig(**self.CFG))
+        tied = GPT2Model(GPTConfig(tie_weights=True, **self.CFG))
+        nu, nt = untied.num_params(), tied.num_params()
+        assert nu - nt == 128 * 32  # exactly the lm_head table
+        p = tied.init(jax.random.PRNGKey(0))
+        idx = jnp.array([[1, 2, 3]], jnp.int32)
+        a = tied.generate(p, idx, 6, temperature=0.0, use_cache=True)
+        b = tied.generate(p, idx, 6, temperature=0.0, use_cache=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_tied_grad_flows_through_both_uses(self):
+        """d(loss)/d(wte) must include the lm_head contribution: zeroing
+        targets' wte rows still leaves nonzero grad via the projection."""
+        m = GPT2Model(GPTConfig(tie_weights=True, **self.CFG))
+        p = m.init(jax.random.PRNGKey(0))
+        idx = jnp.zeros((2, 8), jnp.int32)  # only token 0 gathered
+        tgt = jnp.full((2, 8), 5, jnp.int32)
+        g = jax.grad(lambda p: m.apply(p, idx, tgt))(p)
+        # rows never gathered (e.g. 100) get grad ONLY via the projection
+        assert float(jnp.abs(g["wte"][100]).sum()) > 0

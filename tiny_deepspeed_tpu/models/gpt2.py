@@ -75,6 +75,12 @@ class GPTConfig:
     #    optimizer step counter, so eval/generate stay deterministic.
     bias: bool = True
     dropout: float = 0.0
+    # wte/lm_head weight tying.  The ACTUAL GPT-2 ties them; the reference
+    # unties (model.py:136-138 creates an independent lm_head), so False is
+    # the parity default.  Tied drops the (vocab, d) lm_head table —
+    # 38.6M params on gpt2-124m — and the gradient flows through both the
+    # gather and the projection use of wte.
+    tie_weights: bool = False
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -191,6 +197,8 @@ class GPT2Model:
             for name in ("h.attn.qkv.b", "h.attn.proj.b",
                          "h.mlp.fc.b", "h.mlp.proj.b"):
                 del params[name]
+        if c.tie_weights:
+            del params["lm_head.w"]  # head projects through wte.T
         return params
 
     def tp_rules(self) -> Dict[str, int]:
@@ -481,21 +489,26 @@ class GPT2Model:
             x, params["ln_f.w"].astype(cd), params["ln_f.b"].astype(cd)
         )
 
+    def _lm_head_w(self, params):
+        """(d, vocab) projection weight — wte.T when tied (the transpose
+        folds into the matmul's dimension numbers, no copy)."""
+        c = self.config
+        w = params["wte"].T if c.tie_weights else params["lm_head.w"]
+        return w.astype(c.compute_dtype)
+
     def head(self, params, x, targets: Optional[jax.Array] = None,
              pctx=None, position=None):
         """Final norm + lm_head (+ loss when targets given)."""
         c = self.config
-        cd = c.compute_dtype
         x = self.final_norm(params, x)
+        w = self._lm_head_w(params)
 
         if targets is not None:
             seq_sharded = pctx is not None and pctx.seq_parallel
             if c.fused_xent and not seq_sharded:
                 from ..ops.softmax_xent import fused_linear_xent
-                return fused_linear_xent(
-                    x, params["lm_head.w"].astype(cd), targets
-                )
-            logits = linear(x, params["lm_head.w"].astype(cd), None)
+                return fused_linear_xent(x, w, targets)
+            logits = linear(x, w, None)
             return softmax_cross_entropy(logits, targets)
         # inference path: one position only (cheap lm_head) — `position`
         # (static or traced int) selects it, default the last
@@ -503,7 +516,7 @@ class GPT2Model:
             x = x[:, -1:]
         else:
             x = jax.lax.dynamic_slice_in_dim(x, position, 1, axis=1)
-        logits = linear(x, params["lm_head.w"].astype(cd), None)
+        logits = linear(x, w, None)
         return logits.astype(jnp.float32)
 
     def apply(self, params, idx, targets: Optional[jax.Array] = None,

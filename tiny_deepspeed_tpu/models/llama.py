@@ -116,7 +116,7 @@ class LlamaModel(GPT2Model):
                 c.param_dtype
             )
 
-        return {
+        params = {
             "wte": nrm(next(keys), (v, d), std),
             "h.ln_1.w": jnp.ones((l, d), c.param_dtype),
             "h.attn.q.w": nrm(next(keys), (l, d, d), std),
@@ -130,6 +130,9 @@ class LlamaModel(GPT2Model):
             "ln_f.w": jnp.ones((d,), c.param_dtype),
             "lm_head.w": nrm(next(keys), (d, v), std),
         }
+        if c.tie_weights:
+            del params["lm_head.w"]
+        return params
 
     def tp_rules(self) -> Dict[str, int]:
         """Column-parallel q/k/v/gate/up, row-parallel o/down, vocab-parallel

@@ -100,6 +100,8 @@ class MoEGPT(GPT2Model):
             for name in ("h.attn.qkv.b", "h.attn.proj.b",
                          "h.moe.fc.b", "h.moe.proj.b"):
                 del params[name]
+        if c.tie_weights:
+            del params["lm_head.w"]
         return params
 
     def tp_rules(self) -> Dict[str, int]:
