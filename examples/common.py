@@ -64,6 +64,12 @@ def parse_args(default_model="gpt2-124m", **defaults):
                    help="linear warmup steps for --lr-schedule")
     p.add_argument("--weight-decay", type=float, default=0.1)
     p.add_argument(
+        "--wd-exclude", default=None, metavar="PAT[,PAT]",
+        help="comma-separated name substrings exempt from weight decay "
+             "(e.g. '.b,ln_' = biases + layernorms; default: decay all, "
+             "the reference's behavior)",
+    )
+    p.add_argument(
         "--grad-clip", type=float, default=0.0, metavar="NORM",
         help="clip gradients to this global L2 norm (0 = off)",
     )
@@ -116,6 +122,18 @@ def parse_args(default_model="gpt2-124m", **defaults):
         "--data", default=None, metavar="TOKENS.bin",
         help="binary uint16 token corpus (nanoGPT .bin convention); "
              "default: synthetic random tokens, the reference demo workload",
+    )
+    p.add_argument(
+        "--eval-every", type=int, default=0, metavar="N",
+        help="every N iters, report mean validation loss over "
+             "--eval-batches forward-only batches (deterministic: no "
+             "dropout, no update)",
+    )
+    p.add_argument("--eval-batches", type=int, default=8, metavar="K")
+    p.add_argument(
+        "--val-data", default=None, metavar="VAL.bin",
+        help="held-out token corpus for --eval-every (default: a "
+             "differently-seeded synthetic stream)",
     )
     p.add_argument(
         "--autotune", nargs="?", const="", default=None, metavar="CACHE.json",
@@ -188,7 +206,13 @@ def run(engine_cls, args, single_device=False):
         if sched_name in ("warmup_linear", "warmup_cosine"):
             kw["total_steps"] = args.iters
         lr = _sched.SCHEDULES[sched_name](args.lr, **kw)
-    opt = AdamW(lr=lr, weight_decay=args.weight_decay)
+    opt = AdamW(
+        lr=lr, weight_decay=args.weight_decay,
+        decay_exclude=tuple(
+            p for p in (getattr(args, "wd_exclude", None) or "").split(",")
+            if p
+        ),
+    )
     train_kw = dict(
         grad_clip=getattr(args, "grad_clip", 0.0) or None,
         loss_scale=getattr(args, "loss_scale", None),
@@ -291,6 +315,14 @@ def run(engine_cls, args, single_device=False):
         metrics = MetricsLogger(args.metrics, stdout=False)
     profile_dir = getattr(args, "profile", None)
 
+    eval_every = getattr(args, "eval_every", 0)
+    val_loader = None
+    if eval_every:
+        val_loader = TokenLoader(
+            getattr(args, "val_data", None), batch=b, seq=args.seq_len,
+            vocab_size=vocab, seed=args.seed + 1,
+        )
+
     rank0 = jax.process_index() == 0
     trace_started = False
     t0 = time.perf_counter()
@@ -318,6 +350,18 @@ def run(engine_cls, args, single_device=False):
             trace_started = False
             if rank0:
                 print(f"profiler trace written to {profile_dir}")
+        if eval_every and (it + 1) % eval_every == 0:
+            vals = []
+            for _ in range(args.eval_batches):
+                vix, vtg = val_loader.next()
+                vals.append(engine.eval_loss(
+                    state, (jnp.asarray(vix), jnp.asarray(vtg))
+                ))
+            vloss = sum(float(v) for v in vals) / len(vals)
+            if rank0:
+                print(f"iter {it:3d} val_loss {vloss:.4f}")
+                if metrics is not None:
+                    metrics.log(it, val_loss=vloss)
         if getattr(args, "save_every", 0) and (it + 1) % args.save_every == 0:
             from tiny_deepspeed_tpu.utils.checkpoint import save_checkpoint
             save_checkpoint(args.save_dir, state, it + 1)
@@ -329,6 +373,8 @@ def run(engine_cls, args, single_device=False):
         print(f"--profile: run too short (< 3 iters past {start_iter}) — "
               f"no trace captured in {profile_dir}")
     loader.close()
+    if val_loader is not None:
+        val_loader.close()
     if metrics is not None:
         metrics.close()
     dt = time.perf_counter() - t0

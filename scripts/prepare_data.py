@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Tokenize a text corpus into the uint16 .bin format TokenLoader consumes.
+
+The training entry points take `--data tokens.bin` / `--val-data val.bin`
+(nanoGPT flat-uint16 convention, data/loader.py); this script produces those
+files from plain text.  The reference has no data tooling at all — its demo
+workload is random tokens (reference example/ddp/train.py:23-24).
+
+Tokenizers:
+  * byte (default): raw UTF-8 bytes, vocab 256.  Always available — this
+    environment has no network egress, and byte-level LMs train fine at
+    small scale.  Pair with a model config whose vocab_size >= 256.
+  * gpt2: transformers GPT2TokenizerFast (vocab 50257, pads into the
+    models' default 50304).  Works only if the tokenizer files are already
+    in the local HF cache; a clear error explains otherwise.
+
+Usage:
+  python scripts/prepare_data.py --input corpus.txt --out-dir data/
+  # -> data/train.bin + data/val.bin (last --val-fraction held out)
+  python examples/ddp/train.py --data data/train.bin --val-data data/val.bin
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def tokenize(text: str, tokenizer: str) -> np.ndarray:
+    if tokenizer == "byte":
+        return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(
+            np.uint16
+        )
+    if tokenizer == "gpt2":
+        try:
+            from transformers import GPT2TokenizerFast
+            tok = GPT2TokenizerFast.from_pretrained(
+                "gpt2", local_files_only=True
+            )
+        except Exception as e:  # noqa: BLE001 - explain the offline gate
+            raise SystemExit(
+                "--tokenizer gpt2 needs the tokenizer files in the local "
+                f"HuggingFace cache (this environment has no network): {e!r}"
+                "\nUse --tokenizer byte instead."
+            )
+        ids = tok(text)["input_ids"]
+        return np.asarray(ids, dtype=np.uint16)
+    raise SystemExit(f"unknown tokenizer {tokenizer!r}")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", required=True, metavar="TEXT.txt")
+    p.add_argument("--out-dir", default=".", metavar="DIR")
+    p.add_argument("--tokenizer", default="byte", choices=("byte", "gpt2"))
+    p.add_argument("--val-fraction", type=float, default=0.1,
+                   help="trailing fraction held out into val.bin (0 = none)")
+    args = p.parse_args()
+
+    with open(args.input, encoding="utf-8") as f:
+        text = f.read()
+    ids = tokenize(text, args.tokenizer)
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    n_val = int(len(ids) * args.val_fraction)
+    splits = [("train.bin", ids[: len(ids) - n_val])]
+    if n_val:
+        splits.append(("val.bin", ids[len(ids) - n_val:]))
+    for name, arr in splits:
+        path = os.path.join(args.out_dir, name)
+        arr.tofile(path)
+        print(f"{path}: {len(arr)} tokens "
+              f"(max id {int(arr.max()) if len(arr) else 0})")
+    if args.tokenizer == "byte":
+        print("byte tokenizer: use a model config with vocab_size >= 256 "
+              "(e.g. the 'tiny' preset's 512)")
+
+
+if __name__ == "__main__":
+    main()

@@ -104,3 +104,33 @@ class TestAdamW:
             PARAM, GRADS,
         )
         np.testing.assert_allclose(mine, ref, rtol=1e-4, atol=1e-6)
+
+
+class TestDecayExclude:
+    """decay_exclude: name-pattern weight-decay exemptions (standard
+    practice exempts biases/norms; the reference decays uniformly)."""
+
+    def test_excluded_param_gets_no_decay(self):
+        import jax
+        from tiny_deepspeed_tpu import AdamW
+        params = {"w": jnp.full((4,), 2.0), "ln_1.b": jnp.full((4,), 2.0)}
+        grads = {"w": jnp.zeros((4,)), "ln_1.b": jnp.zeros((4,))}
+        opt = AdamW(lr=0.1, weight_decay=0.5, decoupled=True,
+                    decay_exclude=(".b", "ln_"))
+        state = opt.init(params)
+        new, _ = opt.update(params, grads, state)
+        # zero grad: decoupled wd shrinks "w", leaves the excluded leaf
+        assert float(new["w"][0]) < 2.0
+        np.testing.assert_array_equal(np.asarray(new["ln_1.b"]),
+                                      np.asarray(params["ln_1.b"]))
+
+    def test_l2_mode_and_sgd(self):
+        from tiny_deepspeed_tpu import SGD
+        params = {"w": jnp.full((4,), 2.0), "h.mlp.fc.b": jnp.full((4,), 2.0)}
+        grads = {k: jnp.zeros((4,)) for k in params}
+        opt = SGD(lr=0.1, weight_decay=0.5, decay_exclude=(".b",))
+        state = opt.init(params)
+        new, _ = opt.update(params, grads, state)
+        assert float(new["w"][0]) < 2.0
+        np.testing.assert_array_equal(np.asarray(new["h.mlp.fc.b"]),
+                                      np.asarray(params["h.mlp.fc.b"]))

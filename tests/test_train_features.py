@@ -254,3 +254,32 @@ def test_checkpoint_roundtrip_with_scaler(tmp_path, model):
     restored = load_checkpoint(str(tmp_path), eng, step=1)
     assert float(restored.scaler["scale"]) == 2.0 ** 16
     assert int(restored.opt_state["step"]) == 1
+
+
+class TestEvalLoss:
+    def test_matches_apply_and_is_stateless(self, model):
+        from tiny_deepspeed_tpu import Zero3
+        eng = Zero3(model, AdamW(lr=1e-3))
+        state = eng.init(jax.random.PRNGKey(0))
+        batch = make_batch(jax.random.PRNGKey(100))
+        direct = float(model.apply(state.params, *batch))
+        v1 = float(eng.eval_loss(state, batch))
+        v2 = float(eng.eval_loss(state, batch))
+        assert v1 == pytest.approx(direct, rel=1e-5)
+        assert v1 == v2  # deterministic, no state advanced
+
+    def test_no_dropout_at_eval(self):
+        cfg = GPTConfig(block_size=32, vocab_size=128, n_layer=2, n_head=2,
+                        n_embd=32, compute_dtype=jnp.float32, dropout=0.3)
+        m = GPT2Model(cfg)
+        eng = SingleDevice(m, AdamW(lr=1e-3))
+        state = eng.init(jax.random.PRNGKey(0))
+        batch = make_batch(jax.random.PRNGKey(100))
+        # train loss (dropout on, step 0 key) differs from eval loss
+        _, train_loss = eng.step(state, batch)
+        state2 = eng.init(jax.random.PRNGKey(0))
+        ev = float(eng.eval_loss(state2, batch))
+        # no dropout masks at eval (jit vs eager float reassociation only)
+        assert ev == pytest.approx(float(m.apply(state2.params, *batch)),
+                                   rel=1e-6)
+        assert abs(float(train_loss) - ev) > 1e-4  # train DID use masks

@@ -31,7 +31,8 @@ from .base import Optimizer
 class AdamW(Optimizer):
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=1e-2, amsgrad=False, maximize=False,
-                 decoupled=False, fused=False, state_dtype=jnp.float32):
+                 decoupled=False, fused=False, state_dtype=jnp.float32,
+                 decay_exclude=()):
         """fused: True/"auto" uses the Pallas one-VMEM-pass update kernel
         (optim/adamw_pallas.py; "auto" restricts it to single-device TPU,
         True forces it on single-device TPU/interpret); False (default) uses
@@ -46,7 +47,14 @@ class AdamW(Optimizer):
         state_dtype: storage dtype for the m/v (and vmax) slots.  Update math
         always runs in float32; bfloat16 storage halves optimizer-state HBM
         (the knob that lets GPT-2 1.5B + AdamW fit a single 16 GB v5e chip,
-        BASELINE.md) at the cost of quantized moment carries."""
+        BASELINE.md) at the cost of quantized moment carries.
+
+        decay_exclude: name substrings whose params get NO weight decay
+        (standard practice exempts biases/layernorms — e.g.
+        (".b", "ln_") on the GPT-2 naming; the reference decays every
+        param uniformly, so the empty default is parity).  The optimizer
+        is name-keyed, so this costs nothing: the per-name trace-time loop
+        simply bakes wd=0 into those params' update."""
         super().__init__(lr)
         self.b1, self.b2 = betas
         self.eps = eps
@@ -56,6 +64,12 @@ class AdamW(Optimizer):
         self.decoupled = decoupled
         self.fused = fused
         self.state_dtype = state_dtype
+        self.decay_exclude = tuple(decay_exclude)
+
+    def _wd(self, name: str) -> float:
+        if any(pat in name for pat in self.decay_exclude):
+            return 0.0
+        return self.weight_decay
 
     def _use_fused(self, param) -> bool:
         if self.fused is False:
@@ -113,8 +127,9 @@ class AdamW(Optimizer):
         return state
 
     def update_one(self, name, param, grad, state, step):
+        wd = self._wd(name)
         kw = dict(lr=self._lr(step), b1=self.b1, b2=self.b2, eps=self.eps,
-                  wd=self.weight_decay, decoupled=self.decoupled,
+                  wd=wd, decoupled=self.decoupled,
                   maximize=self.maximize)
         if self._use_fused(param):
             impl = _pallas_update
@@ -147,8 +162,8 @@ class AdamW(Optimizer):
         p = param.astype(jnp.float32)
         if self.maximize:
             g = -g
-        if self.weight_decay and not self.decoupled:
-            g = g + self.weight_decay * p  # reference adamw.py:37-38
+        if wd and not self.decoupled:
+            g = g + wd * p  # reference adamw.py:37-38
         m = self.b1 * state["m"].astype(jnp.float32) + (1.0 - self.b1) * g
         v = (self.b2 * state["v"].astype(jnp.float32)
              + (1.0 - self.b2) * jnp.square(g))
@@ -159,8 +174,8 @@ class AdamW(Optimizer):
         new_state = {"m": m.astype(sd), "v": v.astype(sd),
                      "vmax": vmax.astype(sd)}
         upd = mhat / (jnp.sqrt(vhat) + self.eps)
-        if self.weight_decay and self.decoupled:
-            upd = upd + self.weight_decay * p
+        if wd and self.decoupled:
+            upd = upd + wd * p
         new_p = p - self._lr(step) * upd
         return new_p.astype(param.dtype), new_state
 

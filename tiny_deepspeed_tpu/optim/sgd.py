@@ -17,7 +17,11 @@ from .base import Optimizer
 
 class SGD(Optimizer):
     def __init__(self, lr=1e-3, momentum=0.0, dampening=0.0,
-                 weight_decay=0.0, nesterov=False, maximize=False):
+                 weight_decay=0.0, nesterov=False, maximize=False,
+                 decay_exclude=()):
+        """decay_exclude: name substrings exempt from weight decay (see
+        AdamW.decay_exclude; empty default = the reference's uniform
+        decay)."""
         super().__init__(lr)
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("nesterov requires momentum > 0 and zero dampening")
@@ -26,6 +30,7 @@ class SGD(Optimizer):
         self.weight_decay = weight_decay
         self.nesterov = nesterov
         self.maximize = maximize
+        self.decay_exclude = tuple(decay_exclude)
 
     def init_one(self, name, param):
         if self.momentum:
@@ -35,8 +40,10 @@ class SGD(Optimizer):
     def update_one(self, name, param, grad, state, step):
         g = grad.astype(jnp.float32)
         p = param.astype(jnp.float32)
-        if self.weight_decay:
-            g = g + self.weight_decay * p
+        wd = (0.0 if any(pat in name for pat in self.decay_exclude)
+              else self.weight_decay)
+        if wd:
+            g = g + wd * p
         if self.maximize:
             g = -g
         new_state = state

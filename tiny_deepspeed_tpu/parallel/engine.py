@@ -387,11 +387,24 @@ class ZeroEngine:
             batch_spec = P("data", self.seq_axis)  # (B, T): tokens shard too
         else:
             batch_spec = P()
+        self._eval_batch_sharding = NamedSharding(mesh, batch_spec)
         if self.accum_steps > 1:
             batch_spec = P(None, *batch_spec)
         self._batch_sharding = NamedSharding(mesh, batch_spec)
 
         self._build_step()
+        # forward-only loss (validation): no dropout (no rng), no grads, no
+        # state change; always takes a plain (B, T) batch (no accum axis)
+        self._eval = jax.jit(
+            lambda params, ix, tg: self.model.apply(
+                params, ix, tg, pctx=self.pctx
+            ),
+            in_shardings=(
+                self._param_shardings,
+                self._eval_batch_sharding, self._eval_batch_sharding,
+            ),
+            out_shardings=NamedSharding(mesh, P()),
+        )
 
     def _build_step(self) -> None:
         from ..autotuner import get_default_tuner
@@ -615,15 +628,27 @@ class ZeroEngine:
         or (accum, B, T) when accum_steps > 1."""
         return self._step(state, batch)
 
+    def eval_loss(self, state, batch):
+        """Mean loss on one (B, T) batch — forward only: deterministic (no
+        dropout), no gradients, no state change.  The validation half of
+        the train/eval contract (the reference has no eval path at all)."""
+        idx, targets = batch
+        return self._eval(state.params, idx, targets)
+
     # -- reporting ---------------------------------------------------------
 
     def describe(self) -> str:
         name = type(self).__name__
+        extras = ""
+        if self.grad_clip is not None:
+            extras += f", grad_clip={self.grad_clip}"
+        if self.loss_scale is not None:
+            extras += f", loss_scale={self.loss_scale}"
         return (
             f"{name}(stage={self.stage}, devices={self.n_dev}, "
             f"accum={self.accum_steps}, params sharded="
             f"{self.stage >= 3}, grads sharded={self.stage >= 2}, "
-            f"opt state sharded={self.stage >= 1})"
+            f"opt state sharded={self.stage >= 1}{extras})"
         )
 
 
