@@ -109,6 +109,11 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "or DeepSpeed-Ulysses all-to-all head/seq reshard",
     )
     p.add_argument(
+        "--expert-parallel", type=int, default=1, metavar="EP",
+        help="MoE expert sharding over an 'expert' mesh axis (use with the "
+             "moe-* presets)",
+    )
+    p.add_argument(
         "--pipeline-parallel", type=int, default=1, metavar="PP",
         help="GPipe microbatch pipeline over a 'pipe' mesh axis "
              "(stacked blocks partition into PP stages)",
@@ -230,6 +235,7 @@ def run(engine_cls, args, single_device=False):
             seq_parallel=getattr(args, "seq_parallel", 1),
             seq_impl=getattr(args, "seq_impl", "ring"),
             tensor_parallel=getattr(args, "tensor_parallel", 1),
+            expert_parallel=getattr(args, "expert_parallel", 1),
             pipeline_parallel=getattr(args, "pipeline_parallel", 1),
             pipeline_microbatches=getattr(args, "pipeline_microbatches", 0)
             or None,

@@ -132,3 +132,17 @@ def test_train_feature_flags():
     assert len(losses) == 4
     import math
     assert all(map(math.isfinite, losses.values()))
+
+
+def test_example_moe_family_with_ep():
+    """moe-* presets reachable from every entry point; --expert-parallel
+    carves the 'expert' mesh axis (review r2: MoE was engine-only before)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("examples", "zero2", "train.py"),
+         "--cpu-devices", "8", "--iters", "2", "--model", "moe-tiny",
+         "--expert-parallel", "2", "--seq-len", "128"],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "model=moe-tiny" in proc.stdout
+    assert "done: 2 iters" in proc.stdout, proc.stdout[-2000:]

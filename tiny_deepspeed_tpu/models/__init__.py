@@ -6,11 +6,11 @@ MoE and Llama families (beyond the reference, SURVEY §2.20) — all built on
 the same op layer, stacked-block scan, and engine surface."""
 
 from .gpt2 import GPTConfig, GPT2Model, GPT2_PRESETS
-from .moe import MoEConfig, MoEGPT
+from .moe import MoEConfig, MoEGPT, MOE_PRESETS
 from .llama import LlamaConfig, LlamaModel, LLAMA_PRESETS
 
-# one flat preset namespace across families (tiny / gpt2-* / llama-*)
-ALL_PRESETS = {**GPT2_PRESETS, **LLAMA_PRESETS}
+# one flat preset namespace across families (tiny / gpt2-* / llama-* / moe-*)
+ALL_PRESETS = {**GPT2_PRESETS, **LLAMA_PRESETS, **MOE_PRESETS}
 
 
 def build_model(name_or_cfg):
@@ -28,7 +28,7 @@ def build_model(name_or_cfg):
 
 __all__ = [
     "GPTConfig", "GPT2Model", "GPT2_PRESETS",
-    "MoEConfig", "MoEGPT",
+    "MoEConfig", "MoEGPT", "MOE_PRESETS",
     "LlamaConfig", "LlamaModel", "LLAMA_PRESETS",
     "ALL_PRESETS", "build_model",
 ]

@@ -47,6 +47,21 @@ class MoEConfig(GPTConfig):
     ff_mult: int = 4  # expert hidden = ff_mult * n_embd
 
 
+# Entry-point presets (one flat namespace with gpt2-*/llama-*,
+# models/__init__.ALL_PRESETS).  "moe-tiny" smoke-tests on the virtual CPU
+# mesh in seconds; "moe-8x124m" is the GPT-2-124M skeleton with 8 experts
+# per block (~0.9B params, top-2 routed — the classic Switch/GShard shape).
+MOE_PRESETS = {
+    "moe-tiny": MoEConfig(
+        block_size=256, vocab_size=512, n_layer=2, n_head=2, n_embd=64,
+        n_expert=4, expert_top_k=2, compute_dtype=jnp.float32,
+    ),
+    "moe-8x124m": MoEConfig(
+        n_layer=12, n_head=12, n_embd=768, n_expert=8, expert_top_k=2,
+    ),
+}
+
+
 class MoEGPT(GPT2Model):
     """GPT-2 skeleton with MoE MLPs.  Same functional API as GPT2Model."""
 
