@@ -130,6 +130,14 @@ def _bench_config(model_name: str):
                            fused_xent=True),
             state_dtype=jnp.bfloat16,
         ),
+        # ~0.9B total params, top-2 routed (~2/8 active per token); batch
+        # kept small — expert tensors carry the (E,) axis so weight HBM is
+        # the bound, not activations
+        "moe-8x124m": dict(
+            batch=4,
+            overrides=dict(param_dtype=jnp.bfloat16, fused_xent=True),
+            state_dtype=jnp.bfloat16,
+        ),
     }
     return table.get(model_name,
                      dict(batch=8, overrides={}, state_dtype=None))
@@ -206,7 +214,20 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     embed_params = v * d + (
         0 if isinstance(cfg, LlamaConfig) else cfg.block_size * d
     )
-    flops_tok_matmul = 6 * (n_params - embed_params) + 12 * l * t * d
+    n_active = n_params
+    from tiny_deepspeed_tpu.models.moe import MoEConfig
+    if isinstance(cfg, MoEConfig):
+        # routed experts: only top_k of n_expert run per token — counting
+        # all expert params would overstate FLOPs ~E/k-fold
+        import math as _math
+        expert = sum(
+            int(_math.prod(s.shape))
+            for n, s in model.param_shapes().items()
+            if ".moe." in n and "router" not in n
+        )
+        n_active = (n_params - expert
+                    + expert * cfg.expert_top_k // cfg.n_expert)
+    flops_tok_matmul = 6 * (n_active - embed_params) + 12 * l * t * d
     peak = _peak_flops_per_chip(devices[0])
     toks_per_sec_total = b * t / step_time
     matmul_mfu = flops_tok_matmul * toks_per_sec_total / n_chips / peak
@@ -260,7 +281,7 @@ def main():
 
     if sweep:
         models = ["gpt2-124m", "gpt2-350m", "gpt2-774m", "gpt2-1.5b",
-                  "llama-160m"]
+                  "llama-160m", "moe-8x124m"]
         for name in models:
             rec = None
             for attempt in range(3):  # inline retry for transient outages
