@@ -635,6 +635,15 @@ class ZeroEngine:
         idx, targets = batch
         return self._eval(state.params, idx, targets)
 
+    def gather_params(self, state):
+        """Fully-replicated copy of the params — the bridge from a sharded
+        TrainState to single-program consumers like `model.generate()`
+        (under ZeRO-3 the resting params are axis-sharded; the decode jit
+        is not mesh-aware).  One all-gather per leaf; prefer calling once
+        per sampling session, not per token."""
+        rep = NamedSharding(self.mesh, P())
+        return jax.tree.map(lambda x: jax.device_put(x, rep), state.params)
+
     # -- reporting ---------------------------------------------------------
 
     def describe(self) -> str:
