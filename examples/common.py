@@ -79,6 +79,13 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "implemented working — its own wiring is dead code, reference "
              "model.py:79-81)",
     )
+    p.add_argument(
+        "--gather-quant", choices=("fp8",), default=None,
+        help="ZeRO++-style quantized weight gather (EXPERIMENTAL): block "
+             "weights stack as float8_e4m3 + per-channel scales so the "
+             "ZeRO-3 per-layer gather can move sub-f32 values (backend-"
+             "dependent; models/gpt2.py gather_quant docstring)",
+    )
     def _loss_scale(v):
         if v == "dynamic":
             return v
@@ -187,16 +194,21 @@ def run(engine_cls, args, single_device=False):
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu_devices)
     init_distributed()
+    import dataclasses as _dc
     model_cfg = ALL_PRESETS[args.model]
-    if getattr(args, "dropout", 0.0):
-        import dataclasses as _dc
-        if not any(f.name == "dropout"
-                   for f in _dc.fields(type(model_cfg))):
+
+    def _cfg_override(field, value):
+        if not any(f.name == field for f in _dc.fields(type(model_cfg))):
             raise SystemExit(
-                f"--dropout: the {type(model_cfg).__name__} family has no "
-                "dropout knob"
+                f"--{field.replace('_', '-')}: the "
+                f"{type(model_cfg).__name__} family has no {field} knob"
             )
-        model_cfg = _dc.replace(model_cfg, dropout=args.dropout)
+        return _dc.replace(model_cfg, **{field: value})
+
+    if getattr(args, "dropout", 0.0):
+        model_cfg = _cfg_override("dropout", args.dropout)
+    if getattr(args, "gather_quant", None):
+        model_cfg = _cfg_override("gather_quant", args.gather_quant)
     model = build_model(model_cfg)
 
     lr = args.lr

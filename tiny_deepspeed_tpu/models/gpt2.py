@@ -81,6 +81,25 @@ class GPTConfig:
     # 38.6M params on gpt2-124m — and the gradient flows through both the
     # gather and the projection use of wte.
     tie_weights: bool = False
+    # ZeRO++-style quantized weight gather (qwZ, arxiv 2306.10209), the
+    # float8 variant: "fp8" stacks the block matmul weights as
+    # float8_e4m3 + per-output-channel f32 scales instead of compute-dtype
+    # values, so the per-layer all-gather inside the ZeRO-3 scan moves 2x
+    # fewer bytes than bf16 (4x vs f32); each block dequantizes after the
+    # gather (one multiply, fused by XLA into the matmul).  Scaling/cast
+    # runs ONCE per step from the float32 masters, outside the scan and
+    # outside remat.  fp8 rather than int8 deliberately: the e4m3 cast is
+    # differentiable (FP8-training style), so no straight-through
+    # custom-vjp machinery — the cost is that the per-layer dW cotangent
+    # crosses the same edge in e4m3 (scaled by the same per-channel
+    # absmax), the standard FP8-comm tradeoff.  EXPERIMENTAL; the byte win
+    # is backend-dependent: `_bw` pins the pre-dequant f8 tensor to its
+    # gathered layout, which on XLA CPU makes the FORWARD weight gathers
+    # move f16 (the collective upcasts f8) while some backward/remat
+    # gathers stay full precision — measured structurally in
+    # tests/test_fp8_gather.py; profile on the target backend before
+    # relying on it.  None (default) keeps the exact compute-dtype path.
+    gather_quant: Optional[str] = None
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -238,7 +257,7 @@ class GPT2Model:
         dkey = bp.get("dropout_rng")
 
         h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
-        qkv = linear(h, bp["attn.qkv.w"], bp.get("attn.qkv.b"))
+        qkv = linear(h, self._bw(bp, "attn.qkv.w", pctx), bp.get("attn.qkv.b"))
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(z):  # (B, T, D) -> (B, H, T, Dh)
@@ -247,15 +266,15 @@ class GPT2Model:
         kh, vh = heads(k), heads(v)
         y = sharded_attention(heads(q), kh, vh, c.attn_impl, pctx)
         y = y.swapaxes(1, 2).reshape(b, t, d)
-        y = linear(y, bp["attn.proj.w"], bp.get("attn.proj.b"))
+        y = linear(y, self._bw(bp, "attn.proj.w", pctx), bp.get("attn.proj.b"))
         if dkey is not None:
             y = _dropout(y, jax.random.fold_in(dkey, 0), c.dropout)
         x = x + y
 
         h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
-        h = linear(h, bp["mlp.fc.w"], bp.get("mlp.fc.b"))
+        h = linear(h, self._bw(bp, "mlp.fc.w", pctx), bp.get("mlp.fc.b"))
         h = jax.nn.gelu(h, approximate=True)
-        h = linear(h, bp["mlp.proj.w"], bp.get("mlp.proj.b"))
+        h = linear(h, self._bw(bp, "mlp.proj.w", pctx), bp.get("mlp.proj.b"))
         if dkey is not None:
             h = _dropout(h, jax.random.fold_in(dkey, 1), c.dropout)
         x = x + h
@@ -302,7 +321,7 @@ class GPT2Model:
         c = self.config
         b = x.shape[0]
         h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
-        qkv = linear(h, bp["attn.qkv.w"], bp.get("attn.qkv.b"))
+        qkv = linear(h, self._bw(bp, "attn.qkv.w"), bp.get("attn.qkv.b"))
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads1(z):
@@ -316,16 +335,16 @@ class GPT2Model:
         )
         y = self._decode_attention(heads1(q), ck, cv, pos)
         y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
-        y = linear(y, bp["attn.proj.w"], bp.get("attn.proj.b"))
+        y = linear(y, self._bw(bp, "attn.proj.w"), bp.get("attn.proj.b"))
         return x + y, ck, cv
 
     def _block_decode(self, x, bp, ck, cv, pos):
         """One block, one token: cached attention + MLP."""
         x, ck, cv = self._attn_decode(x, bp, ck, cv, pos)
         h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
-        h = linear(h, bp["mlp.fc.w"], bp.get("mlp.fc.b"))
+        h = linear(h, self._bw(bp, "mlp.fc.w"), bp.get("mlp.fc.b"))
         h = jax.nn.gelu(h, approximate=True)
-        h = linear(h, bp["mlp.proj.w"], bp.get("mlp.proj.b"))
+        h = linear(h, self._bw(bp, "mlp.proj.w"), bp.get("mlp.proj.b"))
         return x + h, ck, cv
 
     def _prefill_body(self, x, bp):
@@ -439,16 +458,64 @@ class GPT2Model:
         pos = params["wpe"][:t].astype(tok.dtype)
         return self._constrain_activations(tok + pos[None], pctx)
 
+    def _quant_eligible(self, name: str, v) -> bool:
+        """Which stacked leaves the fp8 gather applies to: the block matmul
+        weights (ndim >= 3 rules out layernorm w/b and all biases)."""
+        return (self.config.gather_quant == "fp8"
+                and name.endswith(".w") and v.ndim >= 3)
+
     def stacked_compute_params(self, params):
         """The per-block scan xs: "h.*" tensors cast to compute dtype ONCE
         per step — per-layer casts inside the scan would re-read the float32
         masters three times per step (fwd, remat re-fwd, bwd).  Under ZeRO-3
-        this also halves the bytes each per-layer all-gather moves."""
+        this also halves the bytes each per-layer all-gather moves.
+
+        With config.gather_quant="fp8", eligible weights become
+        float8_e4m3 + a per-output-channel f32 scale (key + "#scale") —
+        consumed through `_bw`, which dequantizes after the gather."""
         cd = self.config.compute_dtype
-        return {
-            k[len("h."):]: v.astype(cd)
-            for k, v in params.items() if k.startswith("h.")
-        }
+        out = {}
+        for k, v in params.items():
+            if not k.startswith("h."):
+                continue
+            name = k[len("h."):]
+            if self._quant_eligible(name, v):
+                # per-(layer, out-channel) absmax scale; e4m3 max = 448
+                s = jnp.max(
+                    jnp.abs(v.astype(jnp.float32)),
+                    axis=tuple(range(1, v.ndim - 1)), keepdims=True,
+                ) / 448.0 + 1e-12
+                out[name] = (v / s).astype(jnp.float8_e4m3fn)
+                out[name + "#scale"] = s.astype(jnp.float32)
+            else:
+                out[name] = v.astype(cd)
+        return out
+
+    def _bw(self, bp, name: str, pctx=None):
+        """Block weight from the stacked tree, dequantized when the fp8
+        gather stacked it as (e4m3, scale).
+
+        The sharding constraint pins the PRE-dequant f8 tensor to its
+        gathered layout (tp/ep placements, ZeRO data axis replicated) so
+        GSPMD's per-layer all-gather moves f8 bytes; without it the
+        partitioner computes the dequant multiply shard-side and gathers
+        full precision (observed in the compiled HLO).  Skipped inside the
+        pipeline's manual region, where constraints cannot name manual
+        axes."""
+        w = bp[name]
+        s = bp.get(name + "#scale")
+        if s is None:
+            return w
+        if (pctx is not None and pctx.is_multi_device
+                and not pctx.pipe_parallel
+                and pctx.stacked_specs is not None
+                and name in pctx.stacked_specs):
+            from jax.sharding import NamedSharding
+            w = jax.lax.with_sharding_constraint(
+                w, NamedSharding(pctx.mesh, pctx.stacked_specs[name])
+            )
+        cd = self.config.compute_dtype
+        return w.astype(cd) * s.astype(cd)
 
     def remat_policy(self):
         return {

@@ -172,9 +172,9 @@ class LlamaModel(GPT2Model):
         nq, nkv = c.n_head, c.kv_heads
 
         h = rmsnorm(x, bp["ln_1.w"])
-        q = linear(h, bp["attn.q.w"], None)
-        k = linear(h, bp["attn.k.w"], None)
-        v = linear(h, bp["attn.v.w"], None)
+        q = linear(h, self._bw(bp, "attn.q.w", pctx), None)
+        k = linear(h, self._bw(bp, "attn.k.w", pctx), None)
+        v = linear(h, self._bw(bp, "attn.v.w", pctx), None)
         q = q.reshape(b, t, nq, hd).swapaxes(1, 2)
         k = k.reshape(b, t, nkv, hd).swapaxes(1, 2)
         v = v.reshape(b, t, nkv, hd).swapaxes(1, 2)
@@ -190,16 +190,16 @@ class LlamaModel(GPT2Model):
 
         y = sharded_attention(q, k, v, c.attn_impl, pctx)
         y = y.swapaxes(1, 2).reshape(b, t, d)
-        y = linear(y, bp["attn.o.w"], None)
+        y = linear(y, self._bw(bp, "attn.o.w", pctx), None)
         dkey = bp.get("dropout_rng")
         if dkey is not None:
             y = _dropout(y, jax.random.fold_in(dkey, 0), c.dropout)
         x = x + y
 
         h = rmsnorm(x, bp["ln_2.w"])
-        gate = jax.nn.silu(linear(h, bp["mlp.gate.w"], None))
-        up = linear(h, bp["mlp.up.w"], None)
-        y = linear(gate * up, bp["mlp.down.w"], None)
+        gate = jax.nn.silu(linear(h, self._bw(bp, "mlp.gate.w", pctx), None))
+        up = linear(h, self._bw(bp, "mlp.up.w", pctx), None)
+        y = linear(gate * up, self._bw(bp, "mlp.down.w", pctx), None)
         if dkey is not None:
             y = _dropout(y, jax.random.fold_in(dkey, 1), c.dropout)
         x = x + y
@@ -212,9 +212,9 @@ class LlamaModel(GPT2Model):
         b = x.shape[0]
         hd = c.head_dim
         h = rmsnorm(x, bp["ln_1.w"])
-        q = linear(h, bp["attn.q.w"], None)
-        k = linear(h, bp["attn.k.w"], None)
-        v = linear(h, bp["attn.v.w"], None)
+        q = linear(h, self._bw(bp, "attn.q.w"), None)
+        k = linear(h, self._bw(bp, "attn.k.w"), None)
+        v = linear(h, self._bw(bp, "attn.v.w"), None)
         q = q.reshape(b, 1, c.n_head, hd).swapaxes(1, 2)
         k = k.reshape(b, 1, c.kv_heads, hd).swapaxes(1, 2)
         v = v.reshape(b, 1, c.kv_heads, hd).swapaxes(1, 2)
@@ -229,14 +229,14 @@ class LlamaModel(GPT2Model):
         )
         y = self._decode_attention(q, ck, cv, pos)
         y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
-        return x + linear(y, bp["attn.o.w"], None), ck, cv
+        return x + linear(y, self._bw(bp, "attn.o.w"), None), ck, cv
 
     def _block_decode(self, x, bp, ck, cv, pos):
         x, ck, cv = self._attn_decode(x, bp, ck, cv, pos)
         h = rmsnorm(x, bp["ln_2.w"])
-        gate = jax.nn.silu(linear(h, bp["mlp.gate.w"], None))
-        up = linear(h, bp["mlp.up.w"], None)
-        return x + linear(gate * up, bp["mlp.down.w"], None), ck, cv
+        gate = jax.nn.silu(linear(h, self._bw(bp, "mlp.gate.w"), None))
+        up = linear(h, self._bw(bp, "mlp.up.w"), None)
+        return x + linear(gate * up, self._bw(bp, "mlp.down.w"), None), ck, cv
 
     def _embed_decode(self, params, tok, pos):
         """No wpe table — position enters via RoPE inside each block."""

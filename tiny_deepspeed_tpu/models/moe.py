@@ -204,11 +204,11 @@ class MoEGPT(GPT2Model):
             xe = jax.lax.with_sharding_constraint(
                 xe, NamedSharding(pctx.mesh, P(pctx.expert_axis, None, None))
             )
-        h = jnp.einsum("ecd,edf->ecf", xe, bp["moe.fc.w"])
+        h = jnp.einsum("ecd,edf->ecf", xe, self._bw(bp, "moe.fc.w", pctx))
         if "moe.fc.b" in bp:
             h = h + bp["moe.fc.b"][:, None]
         h = jax.nn.gelu(h, approximate=True)
-        ye = jnp.einsum("ecf,efd->ecd", h, bp["moe.proj.w"])
+        ye = jnp.einsum("ecf,efd->ecd", h, self._bw(bp, "moe.proj.w", pctx))
         if "moe.proj.b" in bp:
             ye = ye + bp["moe.proj.b"][:, None]
         y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), ye)
@@ -221,7 +221,7 @@ class MoEGPT(GPT2Model):
         dkey = bp.get("dropout_rng")
 
         h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
-        qkv = linear(h, bp["attn.qkv.w"], bp.get("attn.qkv.b"))
+        qkv = linear(h, self._bw(bp, "attn.qkv.w", pctx), bp.get("attn.qkv.b"))
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(z):
@@ -230,7 +230,7 @@ class MoEGPT(GPT2Model):
         kh, vh = heads(k), heads(v)
         y = sharded_attention(heads(q), kh, vh, c.attn_impl, pctx)
         y = y.swapaxes(1, 2).reshape(b, t, d)
-        y = linear(y, bp["attn.proj.w"], bp.get("attn.proj.b"))
+        y = linear(y, self._bw(bp, "attn.proj.w", pctx), bp.get("attn.proj.b"))
         if dkey is not None:
             y = _dropout(y, jax.random.fold_in(dkey, 0), c.dropout)
         x = x + y
@@ -264,14 +264,17 @@ class MoEGPT(GPT2Model):
         )
         return x + y, ck, cv
 
+    def _quant_eligible(self, name, v):
+        """Router excluded from the fp8 gather: routing logits need full
+        precision for a stable softmax/top-k."""
+        return super()._quant_eligible(name, v) and "router" not in name
+
     def stacked_compute_params(self, params):
-        """Like GPT2Model's, but router weights stay float32: routing logits
-        need full precision for a stable softmax/top-k."""
-        cd = self.config.compute_dtype
-        return {
-            k[len("h."):]: (v.astype(cd) if "router" not in k else v)
-            for k, v in params.items() if k.startswith("h.")
-        }
+        """Like GPT2Model's (incl. the optional fp8 gather), but router
+        weights stay float32."""
+        out = super().stacked_compute_params(params)
+        out["moe.router.w"] = params["h.moe.router.w"]
+        return out
 
     def apply(self, params, idx, targets: Optional[jax.Array] = None,
               pctx=None, position=None, rng=None):

@@ -368,6 +368,21 @@ class ZeroEngine:
         self._shard_shardings = _to_shardings(specs, mesh)
         # base spec: tensor/expert placements only (no ZeRO data shard)
         base = _param_spec_tree(shapes, 1, reserved)
+        # in-scan specs for the stacked block leaves (leading layer axis
+        # sliced off): what each per-layer weight's gathered layout is —
+        # consumed by the model's fp8-gather path (mesh.ParallelContext.
+        # stacked_specs docstring)
+        stacked_specs = {}
+        for name, s in shapes.items():
+            if not name.startswith("h."):
+                continue
+            entries = list(base[name]) + [None] * (
+                len(s.shape) - len(base[name])
+            )
+            stacked_specs[name[len("h."):]] = P(*entries[1:])
+        self.pctx = dataclasses.replace(
+            self.pctx, stacked_specs=stacked_specs
+        )
         # where params LIVE between steps
         self._param_spec_rest = specs if self.stage >= 3 else base
         self._param_shardings = _to_shardings(self._param_spec_rest, mesh)

@@ -159,6 +159,14 @@ class ParallelContext:
     # O(T/n) memory — parallel/ring_attention.py) or "ulysses" (all-to-all
     # head/sequence reshard, DeepSpeed-Ulysses — parallel/ulysses.py)
     seq_impl: str = "ring"
+    # {stacked leaf name: in-scan PartitionSpec} — the tensor/expert
+    # placements of each per-layer block weight AFTER the leading layer
+    # axis is sliced off.  Consumed by the fp8 gather path (_bw): the
+    # constraint pins the pre-dequant f8 tensor to its gathered layout so
+    # GSPMD moves f8 bytes, not the dequantized f32 (without it the
+    # partitioner fuses the dequant multiply shard-side and gathers full
+    # precision).  None outside an engine.
+    stacked_specs: _Optional[dict] = None
 
     @property
     def is_multi_device(self) -> bool:
