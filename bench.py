@@ -40,6 +40,35 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 MAX_ATTEMPTS = int(os.environ.get("BENCH_MAX_ATTEMPTS", "5"))
 
 
+def _devices_with_timeout(timeout_s: int):
+    """Backend-init probe with a hard timeout: the axon tunnel has been
+    observed to HANG at init (not error) for hours, blocked inside native
+    code — a SIGALRM python handler never fires there, so the probe runs
+    `jax.devices()` in a SUBPROCESS that can be killed.  A timeout or
+    failure raises with the transient UNAVAILABLE signature so
+    _retry_or_diagnose re-execs with backoff; on probe success the caller
+    initializes the backend in-process (fresh connection, probe just
+    proved it comes up)."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        raise RuntimeError(
+            f"UNAVAILABLE: backend init probe timed out after {timeout_s}s "
+            "(hung tunnel)"
+        )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"UNAVAILABLE: backend init probe failed rc={r.returncode}: "
+            f"{r.stderr[-300:]}"
+        )
+    import jax
+    return jax.devices()
+
+
 def _retry_or_diagnose(exc: BaseException) -> None:
     """Transient backend failure -> sleep + re-exec (clean process, clean
     backend state); final failure -> ONE diagnostic JSON line, rc 0.
@@ -274,8 +303,10 @@ def _vs_prev_round(value: float) -> float:
 def main():
     sweep = "--sweep" in sys.argv
     try:
-        import jax
-        jax.devices()  # backend init: the round-1 failure point
+        # backend init: the round-1 failure point (errored) AND the round-2
+        # one (hung) — both paths end in retry-with-backoff or a JSON line
+        _devices_with_timeout(int(os.environ.get("BENCH_INIT_TIMEOUT",
+                                                 "120")))
     except Exception as e:  # noqa: BLE001 - diagnose/retry any init failure
         _retry_or_diagnose(e)
 
