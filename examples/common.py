@@ -19,6 +19,7 @@ engine construction, a 100-iteration loop printing per-iter loss from process
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import jax
@@ -193,6 +194,19 @@ def run(engine_cls, args, single_device=False):
     if getattr(args, "cpu_devices", 0):
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    try:
+        # persistent compile cache next to the package: re-running an entry
+        # point skips the first-step XLA compile (set JAX_CACHE_DIR to move
+        # it; harmless if the config knob is absent)
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_CACHE_DIR", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            )),
+        )
+    except Exception:
+        pass
     init_distributed()
     import dataclasses as _dc
     model_cfg = ALL_PRESETS[args.model]
