@@ -1,0 +1,28 @@
+#!/bin/bash
+# Probe the TPU tunnel until it answers; exit 0 on success.
+# The axon tunnel hangs (not errors) for hours at a time, so each probe runs
+# jax.devices() in a killable subprocess via `timeout`.
+INTERVAL="${TPU_WATCH_INTERVAL:-180}"
+DEADLINE="${TPU_WATCH_DEADLINE:-39600}"  # 11h default
+start=$(date +%s)
+n=0
+while true; do
+  n=$((n + 1))
+  if timeout 75 python -c "import jax; print(jax.devices())" 2>/dev/null; then
+    echo "tpu_watch: tunnel UP after $n probes, $(( $(date +%s) - start ))s"
+    # measure IMMEDIATELY while it's up: default bench populates
+    # .bench_last_good.json (the round-end outage insurance)
+    cd "$(dirname "$0")/.." || exit 0
+    timeout 2400 python bench.py > /tmp/bench_up.json 2> /tmp/bench_up.err
+    echo "tpu_watch: bench rc=$? -> /tmp/bench_up.json"
+    cat /tmp/bench_up.json
+    exit 0
+  fi
+  now=$(date +%s)
+  if (( now - start > DEADLINE )); then
+    echo "tpu_watch: gave up after $n probes, $(( now - start ))s"
+    exit 1
+  fi
+  echo "tpu_watch: probe $n down ($(date -u +%H:%M:%S)), sleeping ${INTERVAL}s"
+  sleep "$INTERVAL"
+done

@@ -84,6 +84,31 @@ class TestFp8Gather:
             f"{len(sub_f32)}"
         )
 
+    def test_loss_curve_tracks_unquantized(self):
+        """Round-2 advice: the dW cotangent crosses the quantization edge in
+        e4m3 (scaled by the forward per-channel absmax) — a real gradient-
+        precision loss.  A strict straight-through estimator can't keep the
+        backward in compute dtype without also gathering full-precision
+        weights (the cotangent must dtype-match the f8 leaf), so instead
+        this validates the consequence directly: a 30-step loss curve under
+        fp8 gather stays within a few percent of the unquantized path."""
+        def run(quant):
+            m = GPT2Model(GPTConfig(
+                gather_quant="fp8" if quant else None, **CFG))
+            eng = SingleDevice(m, AdamW(lr=1e-3))
+            state = eng.init(jax.random.PRNGKey(0))
+            batch = _batch()
+            losses = []
+            for _ in range(30):
+                state, loss = eng.step(state, batch)
+                losses.append(float(loss))
+            return losses
+        base, quant = run(False), run(True)
+        # same init, same data: trajectories must track closely the whole way
+        rel = [abs(a - b) / a for a, b in zip(base, quant)]
+        assert max(rel) < 0.05, f"max divergence {max(rel):.3f}"
+        assert quant[-1] < quant[0] - 0.3  # and it does actually train
+
     @pytest.mark.parametrize("family", ["llama", "moe"])
     def test_other_families(self, family):
         if family == "llama":

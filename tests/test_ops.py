@@ -167,6 +167,26 @@ class TestXent:
         )
         np.testing.assert_allclose(mine, ref, rtol=1e-5, atol=1e-6)
 
+    def test_onehot_variant_matches_gather(self):
+        """The 1F1B head's gather-free CE == the standard path, values AND
+        gradients (the one-hot contraction exists because take_along_axis
+        CHECK-crashes GSPMD inside partial-manual regions)."""
+        from tiny_deepspeed_tpu.ops.softmax_xent import (
+            softmax_cross_entropy_onehot,
+        )
+        k = jax.random.PRNGKey(12)
+        logits = rand(k, 4, 6, 32)
+        targets = jnp.arange(24).reshape(4, 6) % 32
+        a, ga = jax.value_and_grad(ops.softmax_cross_entropy)(
+            logits, targets
+        )
+        b, gb = jax.value_and_grad(softmax_cross_entropy_onehot)(
+            logits, targets
+        )
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                   rtol=1e-5, atol=1e-7)
+
 
 class TestConv:
     """Conv ops — the surface the reference left as empty files (§2.6),

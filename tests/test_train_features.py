@@ -9,6 +9,8 @@ is an unchecked TODO (reference README.md:68).  They are capabilities a
 complete framework needs, built engine-first: clipping/scaling run inside
 the jitted step on (possibly ZeRO-sharded) gradients."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -63,6 +65,10 @@ class TestSchedules:
         isq = schedule.inverse_sqrt(1.0, warmup_steps=4)
         assert float(isq(jnp.int32(2))) == pytest.approx(0.5)
         assert float(isq(jnp.int32(16))) == pytest.approx(0.5)
+
+    def test_warmup_linear_rejects_zero_peak(self):
+        with pytest.raises(ValueError, match="peak_lr"):
+            schedule.warmup_linear(0.0, total_steps=10)
 
     def test_constant_schedule_matches_float_lr(self, model):
         """A constant(x) schedule and lr=x produce identical training."""
@@ -283,6 +289,29 @@ class TestEvalLoss:
         assert ev == pytest.approx(float(m.apply(state2.params, *batch)),
                                    rel=1e-6)
         assert abs(float(train_loss) - ev) > 1e-4  # train DID use masks
+
+    def test_dropout_masks_vary_with_init_seed(self):
+        """Round-2 advice: the dropout base key was a hard-coded
+        PRNGKey(0xD0), so differently-seeded runs replayed identical mask
+        sequences.  Now init(key) folds the user key into the base: two
+        engines holding the SAME params but different init seeds must see
+        different step-0 dropout losses."""
+        cfg = GPTConfig(block_size=32, vocab_size=128, n_layer=2, n_head=2,
+                        n_embd=32, compute_dtype=jnp.float32, dropout=0.3)
+        batch = make_batch(jax.random.PRNGKey(100))
+
+        def step0_loss(seed):
+            m = GPT2Model(cfg)
+            eng = SingleDevice(m, AdamW(lr=1e-3))
+            state = eng.init(jax.random.PRNGKey(seed))
+            # overwrite params with a fixed tree so ONLY the mask stream
+            # differs between the two runs
+            fixed = m.init(jax.random.PRNGKey(7))
+            state = dataclasses.replace(state, params=fixed)
+            _, loss = eng.step(state, batch)
+            return float(loss)
+
+        assert step0_loss(0) != step0_loss(1)
 
 
 def test_gather_params_enables_generate_from_sharded_state(model):

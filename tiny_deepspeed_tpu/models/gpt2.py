@@ -625,6 +625,84 @@ class GPT2Model:
     def __call__(self, params, idx, targets=None, pctx=None, rng=None):
         return self.apply(params, idx, targets, pctx, rng=rng)
 
+    # 1F1B needs the loss INSIDE the pipeline (per-microbatch head at the
+    # last stage), so it cannot ride `apply` + autodiff like GPipe does;
+    # engines with pipeline_schedule="1f1b" call this instead.
+    supports_1f1b = True
+
+    def head_param_names(self):
+        """Params the head (final norm + lm_head) differentiates — the
+        1F1B pipeline accumulates their grads at the last stage."""
+        c = self.config
+        # filtered against the actual param dict at use (llama has no ln_f.b)
+        return ["ln_f.w", "ln_f.b",
+                "wte" if c.tie_weights else "lm_head.w"]
+
+    def loss_and_grad_1f1b(self, params, idx, targets, pctx,
+                           loss_seed=1.0):
+        """(scaled loss, grads) via the 1F1B pipeline schedule
+        (parallel/pipeline.py::spmd_pipeline_1f1b) — same contract as
+        `jax.value_and_grad(lambda p: loss_seed * apply(p, ...))(params)`
+        but with in-flight activations bounded at O(stages) instead of
+        O(microbatches).  The pipeline hands back cotangents at its three
+        seams (stacked block params, head params, embedded activations);
+        explicit vjps push them to the master params and the pieces sum."""
+        if self.config.dropout:
+            raise NotImplementedError(
+                "1F1B + dropout: per-microbatch mask folding is only "
+                "implemented for the GPipe schedule"
+            )
+        if self.config.gather_quant:
+            raise NotImplementedError(
+                "1F1B + gather_quant: quantized stacked leaves need f8 "
+                "cotangent plumbing; use the GPipe schedule"
+            )
+        if pctx is None or pctx.pipe_axis is None:
+            raise ValueError("loss_and_grad_1f1b needs a pipeline pctx")
+        if pctx.seq_parallel:
+            raise NotImplementedError(
+                "1F1B + sequence parallel: use the GPipe schedule"
+            )
+        from ..parallel.pipeline import spmd_pipeline_1f1b
+
+        x, embed_vjp = jax.vjp(lambda p: self.embed(p, idx, pctx), params)
+        stacked, stacked_vjp = jax.vjp(self.stacked_compute_params, params)
+        head_names = [n for n in self.head_param_names() if n in params]
+        head_params = {n: params[n] for n in head_names}
+
+        def head_fn(hp, y, tg):
+            # one-hot CE, not the gather/fused paths: this head runs inside
+            # the pipeline's partial-manual region where the take_along_axis
+            # gather on (possibly vocab-sharded) logits CHECK-crashes the
+            # SPMD partitioner (ops/softmax_xent.py::softmax_cross_entropy_
+            # onehot); per-microbatch logits keep the memory bounded anyway
+            from ..ops.softmax_xent import softmax_cross_entropy_onehot
+            from ..ops.linear import linear
+            h = self.final_norm(hp, y)
+            return softmax_cross_entropy_onehot(
+                linear(h, self._lm_head_w(hp), None), tg
+            )
+
+        loss, dstacked, dhead, dx = spmd_pipeline_1f1b(
+            self.block_fn(pctx), head_fn, stacked, head_params,
+            x, targets,
+            mesh=pctx.mesh,
+            pipe_axis=pctx.pipe_axis or "pipe",
+            data_axis=pctx.data_axis,
+            microbatches=pctx.pipe_microbatches or None,
+            loss_seed=loss_seed,
+        )
+        g_embed = embed_vjp(dx.astype(x.dtype))[0]
+        g_stack = stacked_vjp(dstacked)[0]
+        grads = jax.tree.map(
+            lambda a, b: a.astype(jnp.float32) + b.astype(jnp.float32),
+            g_embed, g_stack,
+        )
+        for n, g in dhead.items():
+            grads[n] = grads[n] + g.astype(jnp.float32)
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+        return loss, grads
+
     def generate(self, params, idx, max_new_tokens: int, *,
                  temperature: float = 1.0, top_k: Optional[int] = None,
                  key=None, use_cache: bool = True):

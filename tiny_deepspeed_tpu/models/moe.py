@@ -68,6 +68,9 @@ class MoEGPT(GPT2Model):
     # apply() carries the aux load-balance loss through the scan AND through
     # the GPipe pipeline (spmd_pipeline with_aux: bubble ticks masked)
     pipeline_capable = True
+    # 1F1B computes grads via explicit per-tick vjp with no aux-loss
+    # plumbing; MoE pipelines stay on the GPipe schedule
+    supports_1f1b = False
 
     def __init__(self, config: MoEConfig):
         super().__init__(config)

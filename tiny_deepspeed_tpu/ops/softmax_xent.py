@@ -27,6 +27,23 @@ def softmax_cross_entropy(logits, targets):
     return jnp.mean(logz - gold)
 
 
+def softmax_cross_entropy_onehot(logits, targets):
+    """Same mean NLL via a one-hot contraction instead of take_along_axis.
+
+    The gather in the standard path trips XLA's SPMD partitioner when it
+    runs on vocab-sharded logits INSIDE a partial-manual shard_map region
+    (CHECK failure in PartitionGather/ExpandDeviceGroupsWithIota on a
+    3-axis mesh) — the 1F1B pipeline computes the loss per microbatch at
+    the last stage, exactly that situation.  One-hot multiply + sum
+    partitions as elementwise + psum over the vocab shards, which GSPMD
+    handles everywhere."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    onehot = jax.nn.one_hot(targets, logits.shape[-1], dtype=jnp.float32)
+    gold = jnp.sum(onehot * logits, axis=-1)
+    return jnp.mean(logz - gold)
+
+
 # ---------------------------------------------------------------------------
 # fused lm_head matmul + cross-entropy (chunked over the sequence)
 # ---------------------------------------------------------------------------

@@ -32,6 +32,9 @@ def warmup_linear(peak_lr: float, total_steps: int, warmup_steps: int = 0,
                   min_lr: float = 0.0):
     """Linear ramp 0 -> peak over `warmup_steps`, then linear decay to
     `min_lr` at `total_steps` (held there after)."""
+    if peak_lr <= 0.0:
+        raise ValueError(f"warmup_linear: peak_lr must be > 0, got {peak_lr}")
+
     def sched(step):
         t = step.astype(jnp.float32)
         warm = t / jnp.maximum(1.0, float(warmup_steps))

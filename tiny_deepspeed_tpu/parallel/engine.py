@@ -191,6 +191,7 @@ class ZeroEngine:
         expert_parallel: int = 1,
         pipeline_parallel: int = 1,
         pipeline_microbatches: Optional[int] = None,
+        pipeline_schedule: str = "gpipe",
         grad_clip: Optional[float] = None,
         loss_scale=None,
         loss_scale_growth_interval: int = 2000,
@@ -209,6 +210,13 @@ class ZeroEngine:
         (parallel/pipeline.py; `pipeline_microbatches` defaults to S).
         All compose with every ZeRO stage (the data axis keeps the ZeRO
         semantics); all are absent from the reference (SURVEY §2.20).
+
+        pipeline_schedule: "gpipe" (default — forward-all-then-backward-all
+        via autodiff, O(M) in-flight activations) or "1f1b" (combined
+        fwd/bwd tick schedule, O(S) in-flight — raise microbatches to
+        amortize the bubble without the activation bill; see
+        pipeline.py::spmd_pipeline_1f1b for the restrictions: no MoE aux,
+        no dropout, no sequence parallel, no gather_quant).
 
         grad_clip: clip gradients to this global L2 norm (computed across
         every leaf; under ZeRO-2/3 the per-leaf square-sums run on the
@@ -270,6 +278,23 @@ class ZeroEngine:
                 "forward (pipeline_capable=False); pipeline_parallel would "
                 "silently run un-pipelined with the layer axis sharded"
             )
+        if pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pipeline_schedule must be 'gpipe' or "
+                             f"'1f1b', got {pipeline_schedule!r}")
+        self._use_1f1b = pipeline_schedule == "1f1b"
+        if self._use_1f1b:
+            # reject rather than silently run un-pipelined autodiff — a
+            # user benchmarking "1f1b" must get the 1f1b code path
+            if self.pipe_axis is None:
+                raise ValueError(
+                    "pipeline_schedule='1f1b' requires pipeline_parallel "
+                    "> 1 (no 'pipe' mesh axis is active)"
+                )
+            if not getattr(model, "supports_1f1b", False):
+                raise ValueError(
+                    f"{type(model).__name__} does not support the 1F1B "
+                    "schedule (no loss_and_grad_1f1b); use 'gpipe'"
+                )
         if seq_impl not in ("ring", "ulysses"):
             raise ValueError(f"seq_impl must be 'ring' or 'ulysses', "
                              f"got {seq_impl!r}")
@@ -299,6 +324,10 @@ class ZeroEngine:
         self._dropout_active = bool(
             getattr(getattr(model, "config", None), "dropout", 0.0)
         )
+        # base key for dropout masks; re-derived from the user's init key in
+        # init() so seeded runs draw different masks (round-2 advice: a
+        # hard-coded base replayed identical masks across all seeds)
+        self._dropout_base = jax.random.PRNGKey(0xD0)
         self.grad_clip = float(grad_clip) if grad_clip else None
         if loss_scale is not None and loss_scale != "dynamic" \
                 and not isinstance(loss_scale, (int, float)):
@@ -480,6 +509,14 @@ class ZeroEngine:
         """Create params + optimizer state directly in their resting
         shardings (no full-replica materialization step — fixes the
         reference's full `.to(rank)` before wrapping, zero1/train.py:34)."""
+        # derive the dropout base from the user's key (NOT the same stream
+        # as param init) so seeded runs draw distinct mask sequences; the
+        # base is a closure constant of the jitted step, so rebuild it —
+        # otherwise a re-init with a new seed would silently replay the
+        # mask stream the old executable baked in
+        if self._dropout_active:
+            self._dropout_base = jax.random.fold_in(key, 0xD0)
+            self._build_step()
         params = jax.jit(
             self.model.init, out_shardings=self._param_shardings
         )(key)
@@ -515,7 +552,7 @@ class ZeroEngine:
             scale = None
 
         rng = (
-            jax.random.fold_in(jax.random.PRNGKey(0xD0), state.opt_state["step"])
+            jax.random.fold_in(self._dropout_base, state.opt_state["step"])
             if self._dropout_active else None
         )
 
@@ -526,10 +563,18 @@ class ZeroEngine:
             # whole backward runs on scaled values (fp16 AMP)
             return l * scale if scale is not None else l
 
+        def loss_and_grads(p, ix, tg, rng=None):
+            if self._use_1f1b:
+                # grads computed INSIDE the pipeline (per-tick vjp) — the
+                # 1F1B schedule can't be expressed through autodiff
+                return self.model.loss_and_grad_1f1b(
+                    p, ix, tg, pctx=self.pctx,
+                    loss_seed=scale if scale is not None else 1.0,
+                )
+            return jax.value_and_grad(loss_fn)(p, ix, tg, rng)
+
         if self.accum_steps == 1:
-            loss, grads = jax.value_and_grad(loss_fn)(
-                params, idx, targets, rng
-            )
+            loss, grads = loss_and_grads(params, idx, targets, rng)
         else:
             # Microbatch accumulation: batch is (accum, B, T); grads summed
             # locally across microbatches, collective cost paid once — the
@@ -540,7 +585,7 @@ class ZeroEngine:
                 ix, tg, mb_i = mb
                 mb_rng = (jax.random.fold_in(rng, mb_i)
                           if rng is not None else None)
-                l, g = jax.value_and_grad(loss_fn)(params, ix, tg, mb_rng)
+                l, g = loss_and_grads(params, ix, tg, mb_rng)
                 acc_grads = jax.tree.map(
                     lambda a, b: a + b.astype(jnp.float32), acc_grads, g
                 )

@@ -200,3 +200,224 @@ def spmd_pipeline(
     y, aux = res if with_aux else (res, None)
     y = y.reshape(b, *x.shape[1:]).astype(dtype)
     return (y, aux) if with_aux else y
+
+
+def spmd_pipeline_1f1b(
+    block_fn,
+    head_fn,
+    stacked,
+    head_params,
+    x,
+    targets,
+    *,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+    data_axis: Optional[str] = "data",
+    microbatches: Optional[int] = None,
+    loss_seed=1.0,
+):
+    """1F1B-schedule pipeline: combined forward AND backward in ONE tick
+    scan, bounding in-flight activations at O(S) instead of GPipe's O(M).
+
+    GPipe (`spmd_pipeline` + autodiff) first forwards all M microbatches —
+    stacking M outputs and M ticks of autodiff residuals — then transposes
+    the whole scan.  Activation memory therefore grows with M exactly where
+    M must grow to amortize the (S-1)/(M+S-1) bubble.  The 1F1B fix is to
+    START each microbatch's backward as soon as its forward leaves the last
+    stage, which requires the LOSS inside the pipeline: the last stage runs
+    `head_fn` per microbatch and seeds the backward immediately.
+
+    Autodiff cannot express that interleaving (a custom_vjp split into
+    separate fwd/bwd phases must stash O(M) residuals), so this function
+    computes gradients EXPLICITLY: each tick runs one slab forward and one
+    slab backward (`jax.vjp` recompute from a (2S-1)-slot input stash ring
+    — the 1F1B activation bound, with recompute-in-backward like
+    GPipe-under-remat).  Schedule, with j = microbatch, s = stage:
+        forward  of j at stage s: tick j + s
+        head + dy of j           : tick j + S - 1   (last stage)
+        backward of j at stage s: tick j + 2S - 1 - s
+    Total ticks M + 2S - 1 — the same O(M + S) wall clock as GPipe's
+    fwd+bwd pair; what changes is the memory bound, not the bubble.
+
+    block_fn:    (x, block_params) -> x (no aux — MoE unsupported here).
+    head_fn:     (head_params, y_mb, targets_mb) -> scalar token-mean loss.
+    stacked:     (n_layer, ...) pytree, layer axis sharded over pipe.
+    head_params: pytree the head differentiates (final norm + lm_head).
+    loss_seed:   cotangent seeding each microbatch loss (AMP loss scale).
+
+    Returns (loss, dstacked, dhead, dx):
+        loss    = loss_seed * mean over microbatches of head_fn loss,
+        dstacked/dhead/dx = gradients of that same scaled mean — exactly
+        what `value_and_grad(lambda ...: loss_seed * mean_loss)` yields,
+        so the caller composes embedding/master-param vjps around it.
+    """
+    s = mesh.shape[pipe_axis]
+    m = int(microbatches) if microbatches else s
+    b = x.shape[0]
+    n_layer = jax.tree.leaves(stacked)[0].shape[0]
+    if n_layer % s:
+        raise ValueError(f"n_layer={n_layer} not divisible by pipeline "
+                         f"stages {s}")
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    dtype = x.dtype
+    f32 = jnp.float32
+
+    def slab_fwd(loc, xi):
+        def body(c, bp):
+            return block_fn(c, bp), None
+        return jax.lax.scan(body, xi, loc)[0]
+
+    seed = jnp.asarray(loss_seed, f32)
+
+    if s == 1:
+        # no pipeline: one explicit vjp over scan+head, same return contract
+        def full(st, hp, xx):
+            return head_fn(hp, slab_fwd(st, xx), targets).astype(f32)
+        loss, vjp = jax.vjp(full, stacked, head_params, x)
+        dstacked, dhead, dx = vjp(seed)
+        return loss * seed, dstacked, dhead, dx
+
+    mb = b // m
+    k = 2 * s - 1                 # stash slots: max in-flight per stage
+    nt = m + 2 * s - 1            # ticks until the last backward drains
+    xmb = x.reshape(m, mb, *x.shape[1:])
+    tmb = targets.reshape(m, mb, *targets.shape[1:])
+    if data_axis is not None and data_axis in mesh.axis_names:
+        xmb = jax.lax.with_sharding_constraint(
+            xmb, NamedSharding(mesh, P(None, data_axis))
+        )
+        tmb = jax.lax.with_sharding_constraint(
+            tmb, NamedSharding(mesh, P(None, data_axis))
+        )
+
+    def local(stacked_loc, head_loc, xmb, tmb, seed):
+        stage = jax.lax.axis_index(pipe_axis)
+        shift_fwd = [(i, i + 1) for i in range(s - 1)]
+        shift_bwd = [(i, i - 1) for i in range(1, s)]
+        act_shape = xmb.shape[1:]
+        zero_act = jnp.zeros(act_shape, dtype)
+
+        def zeros_f32(tree):
+            return jax.tree.map(lambda v: jnp.zeros(v.shape, f32), tree)
+
+        carry0 = dict(
+            state=zero_act,               # fwd activation arriving this tick
+            db=zero_act,                  # bwd cotangent arriving this tick
+            pending=zero_act,             # last stage: dy awaiting next tick
+            stash=jnp.zeros((k,) + act_shape, dtype),
+            dslab=zeros_f32(stacked_loc),
+            dhead=zeros_f32(head_loc),
+            dx=jnp.zeros((m,) + act_shape, f32),
+            loss=jnp.zeros((), f32),
+        )
+
+        def tick(c, t):
+            # -- backward half FIRST: reads the stash slot the forward half
+            # overwrites this very tick (slot residency is exactly k ticks
+            # at stage 0)
+            jb = t - (2 * s - 1) + stage
+            valid_b = (jb >= 0) & (jb < m)
+            slot_b = jnp.mod(t - (2 * s - 1) + 2 * stage, k)
+            x_in_b = jax.lax.dynamic_index_in_dim(
+                c["stash"], slot_b, 0, keepdims=False
+            )
+            cot = jnp.where(stage == s - 1, c["pending"], c["db"])
+            _, vjp = jax.vjp(slab_fwd, stacked_loc, x_in_b)
+            dsl, dxi = vjp(cot)
+            w_b = valid_b.astype(f32)
+            dslab = jax.tree.map(
+                lambda a, g: a + w_b * g.astype(f32), c["dslab"], dsl
+            )
+            dx = jnp.where(
+                valid_b & (stage == 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    c["dx"], dxi.astype(f32), jnp.clip(jb, 0, m - 1), 0
+                ),
+                c["dx"],
+            )
+            db_next = jax.lax.ppermute(
+                jnp.where(valid_b, dxi.astype(dtype), zero_act),
+                pipe_axis, shift_bwd,
+            )
+
+            # -- forward half
+            jf = t - stage
+            valid_f = (jf >= 0) & (jf < m)
+            jf_c = jnp.clip(jf, 0, m - 1)
+            inj = jax.lax.dynamic_index_in_dim(xmb, jf_c, 0, keepdims=False)
+            x_in_f = jnp.where(stage == 0, inj, c["state"])
+            stash = jnp.where(
+                valid_f,
+                jax.lax.dynamic_update_index_in_dim(
+                    c["stash"], x_in_f, jnp.mod(t, k), 0
+                ),
+                c["stash"],
+            )
+            y = slab_fwd(stacked_loc, x_in_f)
+
+            # -- head: loss + dy for the microbatch leaving the last stage.
+            # lax.cond, not masking: the head is the costliest single op
+            # (the (d, vocab) projection) and runs ONLY where the predicate
+            # holds — a masked version would compute it S times per tick.
+            # The predicate is uniform across the non-pipe mesh axes (it
+            # depends only on the pipe coordinate), so GSPMD-inserted
+            # collectives inside the branch agree across their groups.
+            tg = jax.lax.dynamic_index_in_dim(tmb, jf_c, 0, keepdims=False)
+
+            def head_branch(_):
+                lj, head_vjp = jax.vjp(
+                    lambda hp, yy: head_fn(hp, yy, tg).astype(f32),
+                    head_loc, y,
+                )
+                dhp, dy = head_vjp(seed)
+                return (lj, jax.tree.map(lambda g: g.astype(f32), dhp),
+                        dy.astype(dtype))
+
+            def head_skip(_):
+                return jnp.zeros((), f32), zeros_f32(head_loc), zero_act
+
+            lj, dhp, dy = jax.lax.cond(
+                valid_f & (stage == s - 1), head_branch, head_skip, None
+            )
+            dhead = jax.tree.map(
+                lambda a, g: a + g, c["dhead"], dhp
+            )
+            loss = c["loss"] + lj * seed
+            state_next = jax.lax.ppermute(y, pipe_axis, shift_fwd)
+            return dict(
+                state=state_next, db=db_next, pending=dy,
+                stash=stash, dslab=dslab, dhead=dhead, dx=dx, loss=loss,
+            ), None
+
+        c, _ = jax.lax.scan(tick, carry0, jnp.arange(nt))
+        # loss/dhead live on the last stage, dx on stage 0; psum broadcasts
+        # (all in f32 — XLA CPU's AllReducePromotion pass cannot clone
+        # sub-f32 all-reduces inside manual regions, and f32 is the right
+        # accumulation dtype anyway)
+        loss = jax.lax.psum(c["loss"], pipe_axis) / m
+        dhead = jax.tree.map(
+            lambda g: jax.lax.psum(g, pipe_axis) / m, c["dhead"]
+        )
+        dx = jax.lax.psum(c["dx"], pipe_axis) / m
+        dslab = jax.tree.map(lambda g: g / m, c["dslab"])
+        return loss, dslab, dhead, dx
+
+    specs = jax.tree.map(lambda _: P(pipe_axis), stacked)
+    head_specs = jax.tree.map(lambda _: P(), head_params)
+    loss, dslab, dhead, dx = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(specs, head_specs, P(), P(), P()),
+        out_specs=(P(), specs, head_specs, P()),
+        axis_names={pipe_axis},
+        check_vma=False,
+    )(stacked, head_params, xmb, tmb, seed)
+    dstacked = jax.tree.map(
+        lambda g, v: g.astype(v.dtype), dslab, stacked
+    )
+    dhead = jax.tree.map(
+        lambda g, v: g.astype(v.dtype), dhead, head_params
+    )
+    dx = dx.reshape(b, *x.shape[1:]).astype(dtype)
+    return loss, dstacked, dhead, dx
