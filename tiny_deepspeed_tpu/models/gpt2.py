@@ -92,12 +92,14 @@ class GPTConfig:
     # differentiable (FP8-training style), so no straight-through
     # custom-vjp machinery — the cost is that the per-layer dW cotangent
     # crosses the same edge in e4m3 (scaled by the same per-channel
-    # absmax), the standard FP8-comm tradeoff.  EXPERIMENTAL; the byte win
-    # is backend-dependent: `_bw` pins the pre-dequant f8 tensor to its
-    # gathered layout, which on XLA CPU makes the FORWARD weight gathers
-    # move f16 (the collective upcasts f8) while some backward/remat
-    # gathers stay full precision — measured structurally in
-    # tests/test_fp8_gather.py; profile on the target backend before
+    # absmax), the standard FP8-comm tradeoff — convergence validated vs
+    # the unquantized path in tests/test_fp8_gather.py (30-step loss
+    # curves within 5%).  EXPERIMENTAL; the byte win is backend-dependent
+    # and on XLA CPU it is NEGATIVE (round-3 measurement, PROFILE.md):
+    # the collective upcasts f8 to f16 and several remat-backward gathers
+    # stay full precision, so the quantized config moves ~1.34x MORE wire
+    # bytes than plain compute dtype (collective ledger pinned in
+    # tests/test_profiling.py).  Profile on the target backend before
     # relying on it.  None (default) keeps the exact compute-dtype path.
     gather_quant: Optional[str] = None
     param_dtype: Any = jnp.float32
