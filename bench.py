@@ -259,6 +259,13 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     bc = _bench_config(model_name)
     b = b or bc["batch"]
     cfg = dataclasses.replace(ALL_PRESETS[model_name], **bc["overrides"])
+    if t > cfg.block_size:
+        # long-context invocation (BENCH_SEQ=4096/8192): widen the position
+        # table and drop the short-context speed knobs — remat back on and
+        # the chunked fused head, or the activation/logit memory at long T
+        # swamps the chip
+        cfg = dataclasses.replace(cfg, block_size=t, remat=True,
+                                  fused_xent=True)
 
     if os.environ.get("BENCH_AUTOTUNE"):
         # per-shape candidate timing at trace time (linear layouts, flash
