@@ -132,6 +132,11 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "amortize the (PP-1)/(M+PP-1) bubble)",
     )
     p.add_argument(
+        "--pipeline-schedule", choices=("gpipe", "1f1b"), default="gpipe",
+        help="gpipe (autodiff, O(M) in-flight activations) or 1f1b "
+             "(combined fwd/bwd tick scan, O(PP) — raise M freely)",
+    )
+    p.add_argument(
         "--data", default=None, metavar="TOKENS.bin",
         help="binary uint16 token corpus (nanoGPT .bin convention); "
              "default: synthetic random tokens, the reference demo workload",
@@ -265,6 +270,7 @@ def run(engine_cls, args, single_device=False):
             pipeline_parallel=getattr(args, "pipeline_parallel", 1),
             pipeline_microbatches=getattr(args, "pipeline_microbatches", 0)
             or None,
+            pipeline_schedule=getattr(args, "pipeline_schedule", "gpipe"),
             **train_kw,
         )
         n_dev = engine.n_dev

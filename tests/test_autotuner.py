@@ -259,3 +259,28 @@ class TestOpsWiring:
                                        atol=1e-7)
         finally:
             set_default_tuner(None)
+
+    def test_fused_xent_chunk_variants(self):
+        """fused_linear_xent's chunk size is a tuner site (round-3: the
+        fixed 128 cost ~8% on the big presets): 4 chunk variants, all
+        computing the same loss/grads, winner baked per shape."""
+        from tiny_deepspeed_tpu.ops.softmax_xent import (
+            _FLX_VARIANTS, fused_linear_xent, softmax_cross_entropy,
+        )
+        assert len(_FLX_VARIANTS) >= 3
+        assert len({f.__name__ for f in _FLX_VARIANTS.values()}) \
+            == len(_FLX_VARIANTS)
+        k = jax.random.split(jax.random.PRNGKey(3), 2)
+        x = jax.random.normal(k[0], (2, 512, 32), jnp.float32)
+        w = jax.random.normal(k[1], (32, 64), jnp.float32) * 0.1
+        tgt = jnp.arange(2 * 512).reshape(2, 512) % 64
+        ref = float(softmax_cross_entropy(
+            jnp.einsum("btd,dv->btv", x, w), tgt))
+        for f in _FLX_VARIANTS.values():
+            np.testing.assert_allclose(float(f(x, w, tgt)), ref, rtol=1e-5)
+
+        t = RuntimeAutoTuner(warmup=1, iters=1)
+        loss = fused_linear_xent(x, w, tgt, tuner=t)
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+        assert len(t.cache) == 1
+        assert next(iter(t.cache.values())) in set(_FLX_VARIANTS.values())

@@ -92,8 +92,82 @@ def _chunk_iter_fwd(x, w, targets, chunk):
     return acc, jnp.moveaxis(logz, 0, 1).reshape(b, t)
 
 
-@jax.custom_vjp
-def fused_linear_xent(x, w, targets):
+def _make_flx_variant(want: int, name: str):
+    """One custom_vjp fused lm_head/xent with a fixed target chunk size.
+
+    Each chunk size is its own module-level function so the runtime
+    autotuner can identify winners by module+name in its AOT cache."""
+
+    @jax.custom_vjp
+    def flx(x, w, targets):
+        chunk = _pick_chunk(x.shape[1], want)
+        loss_sum, _ = _chunk_iter_fwd(x, w, targets, chunk)
+        return loss_sum / (x.shape[0] * x.shape[1])
+
+    def fwd_rule(x, w, targets):
+        chunk = _pick_chunk(x.shape[1], want)
+        loss_sum, logz = _chunk_iter_fwd(x, w, targets, chunk)
+        n = x.shape[0] * x.shape[1]
+        return loss_sum / n, (x, w, targets, logz)
+
+    def bwd_rule(res, g):
+        x, w, targets, logz = res
+        b, t, d = x.shape
+        v = w.shape[1]
+        chunk = _pick_chunk(t, want)
+        nc = t // chunk
+        scale = g / (b * t)
+
+        def body(dw_acc, ci):
+            start = ci * chunk
+            xc = jax.lax.dynamic_slice_in_dim(x, start, chunk, axis=1)
+            tc = jax.lax.dynamic_slice_in_dim(targets, start, chunk, axis=1)
+            lzc = jax.lax.dynamic_slice_in_dim(logz, start, chunk, axis=1)
+            logits = jnp.einsum(
+                "btd,dv->btv", xc, w, preferred_element_type=jnp.float32
+            )
+            p = jnp.exp(logits - lzc[..., None])
+            vocab = jax.lax.broadcasted_iota(jnp.int32, p.shape, 2)
+            p = jnp.where(vocab == tc[..., None], p - 1.0, p) * scale
+            pc = p.astype(x.dtype)  # grads flow at compute precision
+            dxc = jnp.einsum(
+                "btv,dv->btd", pc, w, preferred_element_type=jnp.float32
+            ).astype(x.dtype)
+            dw_acc = dw_acc + jnp.einsum(
+                "btd,btv->dv", xc, pc, preferred_element_type=jnp.float32
+            )
+            return dw_acc, dxc
+
+        dw, dx = jax.lax.scan(body, jnp.zeros((d, v), jnp.float32),
+                              jnp.arange(nc))
+        dx = jnp.moveaxis(dx, 0, 1).reshape(b, t, d)
+        import numpy as np
+        zero = np.zeros(targets.shape, dtype=jax.dtypes.float0)
+        return dx, dw.astype(w.dtype), zero
+
+    flx.defvjp(fwd_rule, bwd_rule)
+    flx.__name__ = name
+    flx.__qualname__ = name
+    return flx
+
+
+# chunk ladder: bigger chunks amortize the (chunk, V) matmul better on the
+# MXU, smaller ones cap live logits lower — a real tradeoff the tuner
+# measures per shape (round-2 note: the fixed 128 cost ~8% at 774M/1.5B).
+# The ladder deliberately stops at 256: the tuner times candidates as
+# standalone jits on an otherwise-empty device, which is blind to the live
+# logits slab (B, chunk, V) competing with model state in the real step —
+# 256 bounds that slab at 2x the long-standing default, a measured-safe
+# envelope, where a 512 winner could OOM the training step it never saw.
+# (Winner identity for the AOT cache is each variant's stable
+# __module__ + __name__, matched against the live candidate list.)
+_FLX_VARIANTS = {
+    want: _make_flx_variant(want, f"fused_linear_xent_c{want}")
+    for want in (64, 128, 256)
+}
+
+
+def fused_linear_xent(x, w, targets, tuner=None):
     """mean NLL of logits = x @ w without materializing the full (B, T, V)
     logits tensor: forward and backward both stream (B, chunk, V) slabs.
 
@@ -103,53 +177,23 @@ def fused_linear_xent(x, w, targets):
     backward (flash-attention-style recompute-over-materialize, applied to
     the loss head).  Replaces the reference's full-logits
     F.cross_entropy(logits.view(-1, V), ...) (reference example/model.py:
-    154-156)."""
-    chunk = _pick_chunk(x.shape[1], 128)
-    loss_sum, _ = _chunk_iter_fwd(x, w, targets, chunk)
-    return loss_sum / (x.shape[0] * x.shape[1])
+    154-156).
 
-
-def _flx_fwd_rule(x, w, targets):
-    chunk = _pick_chunk(x.shape[1], 128)
-    loss_sum, logz = _chunk_iter_fwd(x, w, targets, chunk)
-    n = x.shape[0] * x.shape[1]
-    return loss_sum / n, (x, w, targets, logz)
-
-
-def _flx_bwd_rule(res, g):
-    x, w, targets, logz = res
-    b, t, d = x.shape
-    v = w.shape[1]
-    chunk = _pick_chunk(t, 128)
-    nc = t // chunk
-    scale = g / (b * t)
-
-    def body(dw_acc, ci):
-        start = ci * chunk
-        xc = jax.lax.dynamic_slice_in_dim(x, start, chunk, axis=1)
-        tc = jax.lax.dynamic_slice_in_dim(targets, start, chunk, axis=1)
-        lzc = jax.lax.dynamic_slice_in_dim(logz, start, chunk, axis=1)
-        logits = jnp.einsum(
-            "btd,dv->btv", xc, w, preferred_element_type=jnp.float32
-        )
-        p = jnp.exp(logits - lzc[..., None])
-        vocab = jax.lax.broadcasted_iota(jnp.int32, p.shape, 2)
-        p = jnp.where(vocab == tc[..., None], p - 1.0, p) * scale
-        pc = p.astype(x.dtype)  # grads flow at compute precision
-        dxc = jnp.einsum(
-            "btv,dv->btd", pc, w, preferred_element_type=jnp.float32
-        ).astype(x.dtype)
-        dw_acc = dw_acc + jnp.einsum(
-            "btd,btv->dv", xc, pc, preferred_element_type=jnp.float32
-        )
-        return dw_acc, dxc
-
-    dw, dx = jax.lax.scan(body, jnp.zeros((d, v), jnp.float32),
-                          jnp.arange(nc))
-    dx = jnp.moveaxis(dx, 0, 1).reshape(b, t, d)
-    import numpy as np
-    zero = np.zeros(targets.shape, dtype=jax.dtypes.float0)
-    return dx, dw.astype(w.dtype), zero
-
-
-fused_linear_xent.defvjp(_flx_fwd_rule, _flx_bwd_rule)
+    The target chunk size is an autotuner site (chunk ladder above;
+    default 128 without a tuner).  Caveat shared with the other sites
+    (runtime_tuner.py): candidates are timed forward-only standalone jits,
+    a proxy for the fwd+bwd in-graph cost."""
+    if tuner is None:
+        from ..autotuner import get_default_tuner
+        tuner = get_default_tuner()
+    # dedupe by EFFECTIVE chunk (short / divisor-poor T collapses several
+    # wants onto one chunk — no point compiling identical programs), with
+    # the long-standing default first
+    cands, seen = [], set()
+    for want in (128, 64, 256):
+        eff = _pick_chunk(x.shape[1], want)
+        if eff not in seen:
+            seen.add(eff)
+            cands.append(_FLX_VARIANTS[want])
+    impl = tuner.choose(cands, (x, w, targets)) if tuner else cands[0]
+    return impl(x, w, targets)
