@@ -155,6 +155,19 @@ def _retry_or_diagnose(exc: BaseException) -> None:
     # config the cache was saved under — a deterministic failure (compile
     # OOM, lowering error) must surface as 0.0 + error, not as last
     # round's healthy number
+    if os.environ.get("BENCH_DECODE"):
+        # decode mode has its own metric name and no last-good cache (the
+        # cache holds TRAIN throughput — replaying it here would report a
+        # train number as a decode result)
+        print(json.dumps({
+            "metric": f"{model_name}_decode_tokens_per_sec",
+            "value": 0.0,
+            "unit": "tokens/s",
+            "vs_baseline": 0.0,
+            "extra": {"error": repr(exc)[:500], "attempts": attempt + 1,
+                      "transient": transient},
+        }))
+        sys.exit(0)
     cached = _load_last_good() if (transient and _default_config()) else None
     if cached is not None and cached.get("metric", "").startswith(model_name):
         cached.setdefault("extra", {}).update(
@@ -297,10 +310,28 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
 
     if os.environ.get("BENCH_AUTOTUNE"):
         # first trace records candidate requests; retune times them on the
-        # device and re-jits with winners baked (engine.retune docstring)
+        # device and re-jits with winners baked (engine.retune docstring).
+        # Guardrail for the standalone-timing hazard (adamw_pallas.py saw a
+        # standalone winner LOSE in-graph): measure the whole step both
+        # ways and keep the faster program.
         state, _ = engine.step(state, (idx, tgt))
+        base_time, state = measure(engine, state, (idx, tgt), warmup=2,
+                                   iters=8)
         tuned = engine.retune()
-        print(f"bench: autotuned {tuned} sites", file=sys.stderr)
+        tuned_time, state = measure(engine, state, (idx, tgt), warmup=2,
+                                    iters=8)
+        if tuned_time > base_time * 1.005:
+            engine.revert_tune()
+            print(
+                f"bench: autotune REVERTED ({tuned} sites; tuned step "
+                f"{tuned_time * 1e3:.2f}ms > default "
+                f"{base_time * 1e3:.2f}ms)", file=sys.stderr,
+            )
+        else:
+            print(
+                f"bench: autotuned {tuned} sites ({base_time * 1e3:.2f}ms "
+                f"-> {tuned_time * 1e3:.2f}ms)", file=sys.stderr,
+            )
 
     step_time, state = measure(engine, state, (idx, tgt), iters=iters)
     tokens_per_sec_chip = b * t / step_time / n_chips
@@ -362,6 +393,41 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             "config": {
                 k: str(v) for k, v in _bench_config(model_name).items()
             },
+        },
+    }
+
+
+def run_decode(model_name: str, b=8, prompt_t=128, new_tokens=256):
+    """KV-cache decode throughput: tokens/s of model.generate() (greedy,
+    prefill + one cached single-position pass per token).  BENCH_DECODE=1
+    selects this mode; the reference has no sampling loop at all."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    from tiny_deepspeed_tpu.models import ALL_PRESETS, build_model
+
+    cfg = _dc.replace(ALL_PRESETS[model_name],
+                      param_dtype=jnp.bfloat16, remat=False)
+    model = build_model(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    idx = jax.random.randint(jax.random.PRNGKey(1), (b, prompt_t), 0,
+                             cfg.vocab_size, jnp.int32)
+    out = model.generate(params, idx, new_tokens, temperature=0.0)
+    float(out[0, -1])  # warm + sync (compile both prefill and decode jits)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = model.generate(params, idx, new_tokens, temperature=0.0)
+    float(out[0, -1])
+    dt = (time.perf_counter() - t0) / iters
+    return {
+        "metric": f"{model_name}_decode_tokens_per_sec",
+        "value": round(b * new_tokens / dt, 1),
+        "unit": "tokens/s",
+        "extra": {
+            "batch": b, "prompt_t": prompt_t, "new_tokens": new_tokens,
+            "latency_ms_per_token": round(dt / new_tokens * 1e3, 3),
         },
     }
 
@@ -439,6 +505,11 @@ def main():
     b = os.environ.get("BENCH_BATCH")
     t = int(os.environ.get("BENCH_SEQ", "1024"))
     try:
+        if os.environ.get("BENCH_DECODE"):
+            rec = run_decode(model_name, b=int(b) if b else 8)
+            rec["vs_baseline"] = 1.0
+            print(json.dumps(rec))
+            return
         rec = run_one(model_name, b=int(b) if b else None, t=t)
     except Exception as e:  # noqa: BLE001 - diagnose/retry
         _retry_or_diagnose(e)

@@ -50,6 +50,14 @@ assert len(jax.devices()) == 2 * n_proc
 from tiny_deepspeed_tpu import AdamW, DDP, GPT2Model, GPTConfig  # noqa: E402
 
 mesh = make_mesh()  # all 4 global devices on one "data" axis
+# 2 processes x 2 local devices: _n_granules sees distinct process_index
+# values, so make_mesh takes the HYBRID layout path for real (the round-2
+# gap: granule logic was only ever exercised against mocked device attrs).
+# The hybrid grid keeps each process's devices contiguous on the data axis.
+_grid = mesh.devices.ravel()
+_procs = [d.process_index for d in _grid]
+assert sorted(_procs) == [0, 0, 1, 1], _procs
+assert _procs[0] == _procs[1] and _procs[2] == _procs[3], _procs
 cfg = GPTConfig(block_size=16, vocab_size=64, n_layer=2, n_head=2,
                 n_embd=16, compute_dtype=jnp.float32)
 model = GPT2Model(cfg)
