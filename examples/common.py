@@ -137,6 +137,11 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "(combined fwd/bwd tick scan, O(PP) — raise M freely)",
     )
     p.add_argument(
+        "--offload-opt-state", action="store_true",
+        help="ZeRO-Offload-style placement: optimizer moments rest in "
+             "host memory (pinned_host) instead of HBM; TPU runtime only",
+    )
+    p.add_argument(
         "--data", default=None, metavar="TOKENS.bin",
         help="binary uint16 token corpus (nanoGPT .bin convention); "
              "default: synthetic random tokens, the reference demo workload",
@@ -252,6 +257,7 @@ def run(engine_cls, args, single_device=False):
     train_kw = dict(
         grad_clip=getattr(args, "grad_clip", 0.0) or None,
         loss_scale=getattr(args, "loss_scale", None),
+        offload_opt_state=getattr(args, "offload_opt_state", False),
     )
     if single_device:
         engine = engine_cls(
