@@ -113,6 +113,26 @@ class TestCommReportVsCompiledHLO:
                    - rep["zero3_layer_gather_bytes"]) \
             <= 0.1 * rep["zero3_layer_gather_bytes"]
 
+    def test_pipeline_ppermute_counts(self):
+        """Cross-check the ledger's loop multiplication on a different
+        collective/loop structure: the GPipe tick scan runs M+S-1 ticks
+        with one activation ppermute per tick (forward), and autodiff's
+        transposed scan adds the same count backward."""
+        from tiny_deepspeed_tpu import Zero1
+        from tiny_deepspeed_tpu.utils.hlo_comm import hlo_comm_report
+        model = GPT2Model(self.CFG)
+        s_stages, m_micro = 4, 8
+        eng = Zero1(model, AdamW(lr=1e-3), pipeline_parallel=s_stages,
+                    pipeline_microbatches=m_micro)
+        state = eng.init(jax.random.PRNGKey(0))
+        idx = jax.random.randint(jax.random.PRNGKey(1), (16, 64), 0, 256)
+        led = hlo_comm_report(eng, state, (idx, idx))
+        ticks = m_micro + s_stages - 1
+        # fwd scan: 1 ppermute/tick; bwd transposed scan: 1 more.  XLA may
+        # emit the pair fused or cloned, so pin a window, not equality.
+        n = led["count"].get("collective-permute", 0)
+        assert 2 * ticks <= n <= 3 * ticks, (n, ticks)
+
     def test_zero3_fp8_gather_priced_from_stacked_dtypes(self):
         import dataclasses
         q = dataclasses.replace(self.CFG, gather_quant="fp8")
