@@ -367,13 +367,42 @@ class Test1F1B:
         np.testing.assert_allclose(float(loss), float(ref_loss),
                                    rtol=2e-4, atol=2e-4)
 
-    def test_rejections(self):
+    def test_moe_1f1b_matches_gpipe(self):
+        """MoE aux loss through the 1F1B schedule: with identical
+        microbatching the routing (and so the loss) matches GPipe
+        tightly — the aux seeds the backward as a constant cotangent."""
         from tiny_deepspeed_tpu import MoEConfig, MoEGPT
-        moe = MoEGPT(MoEConfig(block_size=32, vocab_size=64, n_layer=2,
-                               n_head=2, n_embd=16, n_expert=2))
+        # aux_loss_weight raised well above the 1e-2 default and 6 steps:
+        # at the defaults a wrong aux-cotangent SCALE (e.g. an extra /m)
+        # stays under a 2e-4 tolerance — this config trips it
+        cfg = MoEConfig(block_size=64, vocab_size=128, n_layer=2,
+                        n_head=2, n_embd=32, n_expert=2,
+                        capacity_factor=2.0, aux_loss_weight=0.5,
+                        compute_dtype=jnp.float32)
+        moe = MoEGPT(cfg)
+        idx, tgt = batch(cfg)
+
+        def run(schedule):
+            eng = Zero1(moe, AdamW(lr=1e-3), pipeline_parallel=2,
+                        pipeline_microbatches=4,
+                        pipeline_schedule=schedule)
+            state = eng.init(jax.random.PRNGKey(0))
+            losses = []
+            for _ in range(6):
+                state, loss = eng.step(state, (idx, tgt))
+                losses.append(float(loss))
+            return losses
+
+        np.testing.assert_allclose(run("1f1b"), run("gpipe"),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_rejections(self):
+        class NoSched(GPT2Model):
+            supports_1f1b = False
+
         with pytest.raises(ValueError, match="1F1B"):
-            Zero1(moe, AdamW(lr=1e-3), pipeline_parallel=2,
-                  pipeline_schedule="1f1b")
+            Zero1(NoSched(tiny_cfg()), AdamW(lr=1e-3),
+                  pipeline_parallel=2, pipeline_schedule="1f1b")
         with pytest.raises(ValueError, match="pipeline_schedule"):
             Zero1(GPT2Model(tiny_cfg()), AdamW(lr=1e-3),
                   pipeline_parallel=2, pipeline_schedule="interleaved")

@@ -632,6 +632,11 @@ class GPT2Model:
     # engines with pipeline_schedule="1f1b" call this instead.
     supports_1f1b = True
 
+    def _pipeline_1f1b_block(self, pctx):
+        """(block_fn, aux_weight, with_aux) for the 1F1B schedule — the
+        hook MoEGPT overrides to thread its load-balance aux loss."""
+        return self.block_fn(pctx), 0.0, False
+
     def head_param_names(self):
         """Params the head (final norm + lm_head) differentiates — the
         1F1B pipeline accumulates their grads at the last stage."""
@@ -667,6 +672,7 @@ class GPT2Model:
             )
         from ..parallel.pipeline import spmd_pipeline_1f1b
 
+        block, aux_w, with_aux = self._pipeline_1f1b_block(pctx)
         x, embed_vjp = jax.vjp(lambda p: self.embed(p, idx, pctx), params)
         stacked, stacked_vjp = jax.vjp(self.stacked_compute_params, params)
         head_names = [n for n in self.head_param_names() if n in params]
@@ -686,13 +692,14 @@ class GPT2Model:
             )
 
         loss, dstacked, dhead, dx = spmd_pipeline_1f1b(
-            self.block_fn(pctx), head_fn, stacked, head_params,
+            block, head_fn, stacked, head_params,
             x, targets,
             mesh=pctx.mesh,
             pipe_axis=pctx.pipe_axis or "pipe",
             data_axis=pctx.data_axis,
             microbatches=pctx.pipe_microbatches or None,
             loss_seed=loss_seed,
+            with_aux=with_aux, aux_weight=aux_w,
         )
         g_embed = embed_vjp(dx.astype(x.dtype))[0]
         g_stack = stacked_vjp(dstacked)[0]

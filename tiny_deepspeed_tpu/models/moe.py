@@ -68,9 +68,27 @@ class MoEGPT(GPT2Model):
     # apply() carries the aux load-balance loss through the scan AND through
     # the GPipe pipeline (spmd_pipeline with_aux: bubble ticks masked)
     pipeline_capable = True
-    # 1F1B computes grads via explicit per-tick vjp with no aux-loss
-    # plumbing; MoE pipelines stay on the GPipe schedule
-    supports_1f1b = False
+    # 1F1B (round 3): the aux loss joins as a constant-cotangent second
+    # output of the layer slab (pipeline.py with_aux), so MoE runs the
+    # O(S)-memory schedule too
+    supports_1f1b = True
+
+    def _block_aux_fn(self, pctx):
+        """(x, bp) -> (x, aux) with the remat policy applied — shared by
+        the GPipe apply() branch and the 1F1B hook."""
+
+        def block_aux(x, bp):
+            return self._block(x, bp, pctx)
+
+        if self.config.remat:
+            block_aux = jax.checkpoint(block_aux,
+                                       policy=self.remat_policy())
+        return block_aux
+
+    def _pipeline_1f1b_block(self, pctx):
+        c = self.config
+        # apply() adds aux_loss_weight * aux_sum / n_layer (below)
+        return self._block_aux_fn(pctx), c.aux_loss_weight / c.n_layer, True
 
     def __init__(self, config: MoEConfig):
         super().__init__(config)
@@ -289,15 +307,8 @@ class MoEGPT(GPT2Model):
         if pctx is not None and pctx.pipe_parallel:
             from ..parallel.pipeline import spmd_pipeline
 
-            def block_aux(x, bp):
-                return self._block(x, bp, pctx)  # -> (x, aux)
-
-            if c.remat:
-                block_aux = jax.checkpoint(
-                    block_aux, policy=self.remat_policy()
-                )
             x, aux_sum = spmd_pipeline(
-                block_aux, stacked, x,
+                self._block_aux_fn(pctx), stacked, x,
                 mesh=pctx.mesh, pipe_axis=pctx.pipe_axis,
                 data_axis=pctx.data_axis,
                 microbatches=pctx.pipe_microbatches or None,

@@ -215,9 +215,9 @@ class ZeroEngine:
         pipeline_schedule: "gpipe" (default — forward-all-then-backward-all
         via autodiff, O(M) in-flight activations) or "1f1b" (combined
         fwd/bwd tick schedule, O(S) in-flight — raise microbatches to
-        amortize the bubble without the activation bill; see
-        pipeline.py::spmd_pipeline_1f1b for the restrictions: no MoE aux,
-        no dropout, no sequence parallel, no gather_quant).
+        amortize the bubble without the activation bill; MoE aux loss
+        supported; see pipeline.py::spmd_pipeline_1f1b for the remaining
+        restrictions: no dropout, no sequence parallel, no gather_quant).
 
         grad_clip: clip gradients to this global L2 norm (computed across
         every leaf; under ZeRO-2/3 the per-leaf square-sums run on the

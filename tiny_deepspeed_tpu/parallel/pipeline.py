@@ -215,6 +215,8 @@ def spmd_pipeline_1f1b(
     data_axis: Optional[str] = "data",
     microbatches: Optional[int] = None,
     loss_seed=1.0,
+    with_aux: bool = False,
+    aux_weight: float = 0.0,
 ):
     """1F1B-schedule pipeline: combined forward AND backward in ONE tick
     scan, bounding in-flight activations at O(S) instead of GPipe's O(M).
@@ -239,17 +241,23 @@ def spmd_pipeline_1f1b(
     Total ticks M + 2S - 1 — the same O(M + S) wall clock as GPipe's
     fwd+bwd pair; what changes is the memory bound, not the bubble.
 
-    block_fn:    (x, block_params) -> x (no aux — MoE unsupported here).
+    block_fn:    (x, block_params) -> x, or -> (x, aux scalar) with
+                 `with_aux` (MoE load-balance loss).
     head_fn:     (head_params, y_mb, targets_mb) -> scalar token-mean loss.
     stacked:     (n_layer, ...) pytree, layer axis sharded over pipe.
     head_params: pytree the head differentiates (final norm + lm_head).
     loss_seed:   cotangent seeding each microbatch loss (AMP loss scale).
+    with_aux / aux_weight: each real tick's summed-layer aux joins the
+                 loss as aux_weight * mean-over-microbatches; its
+                 cotangent is the CONSTANT loss_seed * aux_weight / m, so
+                 it seeds the backward vjp directly — no aux value rides
+                 the pipeline hops.
 
     Returns (loss, dstacked, dhead, dx):
-        loss    = loss_seed * mean over microbatches of head_fn loss,
-        dstacked/dhead/dx = gradients of that same scaled mean — exactly
-        what `value_and_grad(lambda ...: loss_seed * mean_loss)` yields,
-        so the caller composes embedding/master-param vjps around it.
+        loss    = loss_seed * (mean head loss + aux_weight * mean aux),
+        dstacked/dhead/dx = gradients of that same scaled total — exactly
+        what `value_and_grad(lambda ...: loss_seed * total)` yields, so
+        the caller composes embedding/master-param vjps around it.
     """
     s = mesh.shape[pipe_axis]
     m = int(microbatches) if microbatches else s
@@ -264,16 +272,31 @@ def spmd_pipeline_1f1b(
     f32 = jnp.float32
 
     def slab_fwd(loc, xi):
+        """Local layer slab; always returns (y, aux_sum) — aux is a zero
+        scalar without `with_aux` so the vjp plumbing is uniform."""
+        if with_aux:
+            def body(c, bp):
+                xc, a = c
+                xn, anew = block_fn(xc, bp)
+                return (xn, a + anew.astype(jnp.float32)), None
+            (y, aux), _ = jax.lax.scan(
+                body, (xi, jnp.zeros((), jnp.float32)), loc
+            )
+            return y, aux
+
         def body(c, bp):
             return block_fn(c, bp), None
-        return jax.lax.scan(body, xi, loc)[0]
+        y, _ = jax.lax.scan(body, xi, loc)
+        return y, jnp.zeros((), jnp.float32)
 
     seed = jnp.asarray(loss_seed, f32)
+    aw = jnp.float32(aux_weight)
 
     if s == 1:
         # no pipeline: one explicit vjp over scan+head, same return contract
         def full(st, hp, xx):
-            return head_fn(hp, slab_fwd(st, xx), targets).astype(f32)
+            y, aux = slab_fwd(st, xx)
+            return head_fn(hp, y, targets).astype(f32) + aw * aux
         loss, vjp = jax.vjp(full, stacked, head_params, x)
         dstacked, dhead, dx = vjp(seed)
         return loss * seed, dstacked, dhead, dx
@@ -310,6 +333,7 @@ def spmd_pipeline_1f1b(
             dhead=zeros_f32(head_loc),
             dx=jnp.zeros((m,) + act_shape, f32),
             loss=jnp.zeros((), f32),
+            aux=jnp.zeros((), f32),       # summed-layer aux, real ticks only
         )
 
         def tick(c, t):
@@ -324,7 +348,11 @@ def spmd_pipeline_1f1b(
             )
             cot = jnp.where(stage == s - 1, c["pending"], c["db"])
             _, vjp = jax.vjp(slab_fwd, stacked_loc, x_in_b)
-            dsl, dxi = vjp(cot)
+            # aux joins the loss as aux_weight * mean over microbatches;
+            # the accumulated grads are divided by m at the end (like the
+            # head path, whose per-microbatch seed is also un-divided), so
+            # the constant aux cotangent here must NOT carry its own /m
+            dsl, dxi = vjp((cot, seed * aw))
             w_b = valid_b.astype(f32)
             dslab = jax.tree.map(
                 lambda a, g: a + w_b * g.astype(f32), c["dslab"], dsl
@@ -354,7 +382,8 @@ def spmd_pipeline_1f1b(
                 ),
                 c["stash"],
             )
-            y = slab_fwd(stacked_loc, x_in_f)
+            y, aux_t = slab_fwd(stacked_loc, x_in_f)
+            aux_acc = c["aux"] + jnp.where(valid_f, aux_t, 0.0)
 
             # -- head: loss + dy for the microbatch leaving the last stage.
             # lax.cond, not masking: the head is the costliest single op
@@ -388,6 +417,7 @@ def spmd_pipeline_1f1b(
             return dict(
                 state=state_next, db=db_next, pending=dy,
                 stash=stash, dslab=dslab, dhead=dhead, dx=dx, loss=loss,
+                aux=aux_acc,
             ), None
 
         c, _ = jax.lax.scan(tick, carry0, jnp.arange(nt))
@@ -396,6 +426,8 @@ def spmd_pipeline_1f1b(
         # sub-f32 all-reduces inside manual regions, and f32 is the right
         # accumulation dtype anyway)
         loss = jax.lax.psum(c["loss"], pipe_axis) / m
+        # every stage holds its own layers' aux; the pipe-psum sums layers
+        loss = loss + seed * aw * jax.lax.psum(c["aux"], pipe_axis) / m
         dhead = jax.tree.map(
             lambda g: jax.lax.psum(g, pipe_axis) / m, c["dhead"]
         )
