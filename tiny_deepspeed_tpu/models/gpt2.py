@@ -646,19 +646,19 @@ class GPT2Model:
                 "wte" if c.tie_weights else "lm_head.w"]
 
     def loss_and_grad_1f1b(self, params, idx, targets, pctx,
-                           loss_seed=1.0):
+                           loss_seed=1.0, rng=None):
         """(scaled loss, grads) via the 1F1B pipeline schedule
         (parallel/pipeline.py::spmd_pipeline_1f1b) — same contract as
         `jax.value_and_grad(lambda p: loss_seed * apply(p, ...))(params)`
         but with in-flight activations bounded at O(stages) instead of
         O(microbatches).  The pipeline hands back cotangents at its three
         seams (stacked block params, head params, embedded activations);
-        explicit vjps push them to the master params and the pieces sum."""
-        if self.config.dropout:
-            raise NotImplementedError(
-                "1F1B + dropout: per-microbatch mask folding is only "
-                "implemented for the GPipe schedule"
-            )
+        explicit vjps push them to the master params and the pieces sum.
+
+        `rng` enables dropout: per-layer keys ride the pipeline outside
+        the differentiated args, folded per microbatch (independent masks
+        per microbatch, bit-exact backward recompute); the embedding
+        dropout joins the embed vjp here."""
         if self.config.gather_quant:
             raise NotImplementedError(
                 "1F1B + gather_quant: quantized stacked leaves need f8 "
@@ -673,7 +673,19 @@ class GPT2Model:
         from ..parallel.pipeline import spmd_pipeline_1f1b
 
         block, aux_w, with_aux = self._pipeline_1f1b_block(pctx)
-        x, embed_vjp = jax.vjp(lambda p: self.embed(p, idx, pctx), params)
+        drop_keys = None
+        c = self.config
+        if rng is not None and c.dropout:
+            keys = jax.random.split(rng, c.n_layer + 1)
+            drop_keys = keys[1:]
+
+            def embed_fn(p):
+                return _dropout(self.embed(p, idx, pctx), keys[0],
+                                c.dropout)
+        else:
+            def embed_fn(p):
+                return self.embed(p, idx, pctx)
+        x, embed_vjp = jax.vjp(embed_fn, params)
         stacked, stacked_vjp = jax.vjp(self.stacked_compute_params, params)
         head_names = [n for n in self.head_param_names() if n in params]
         head_params = {n: params[n] for n in head_names}
@@ -700,6 +712,7 @@ class GPT2Model:
             microbatches=pctx.pipe_microbatches or None,
             loss_seed=loss_seed,
             with_aux=with_aux, aux_weight=aux_w,
+            rng_stacked=drop_keys,
         )
         g_embed = embed_vjp(dx.astype(x.dtype))[0]
         g_stack = stacked_vjp(dstacked)[0]

@@ -215,9 +215,9 @@ class ZeroEngine:
         pipeline_schedule: "gpipe" (default — forward-all-then-backward-all
         via autodiff, O(M) in-flight activations) or "1f1b" (combined
         fwd/bwd tick schedule, O(S) in-flight — raise microbatches to
-        amortize the bubble without the activation bill; MoE aux loss
-        supported; see pipeline.py::spmd_pipeline_1f1b for the remaining
-        restrictions: no dropout, no sequence parallel, no gather_quant).
+        amortize the bubble without the activation bill; MoE aux loss and
+        dropout supported; see pipeline.py::spmd_pipeline_1f1b for the
+        remaining restrictions: no sequence parallel, no gather_quant).
 
         grad_clip: clip gradients to this global L2 norm (computed across
         every leaf; under ZeRO-2/3 the per-leaf square-sums run on the
@@ -611,6 +611,7 @@ class ZeroEngine:
                 return self.model.loss_and_grad_1f1b(
                     p, ix, tg, pctx=self.pctx,
                     loss_seed=scale if scale is not None else 1.0,
+                    rng=rng,
                 )
             return jax.value_and_grad(loss_fn)(p, ix, tg, rng)
 

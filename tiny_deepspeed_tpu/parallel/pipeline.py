@@ -217,6 +217,7 @@ def spmd_pipeline_1f1b(
     loss_seed=1.0,
     with_aux: bool = False,
     aux_weight: float = 0.0,
+    rng_stacked=None,
 ):
     """1F1B-schedule pipeline: combined forward AND backward in ONE tick
     scan, bounding in-flight activations at O(S) instead of GPipe's O(M).
@@ -252,6 +253,13 @@ def spmd_pipeline_1f1b(
                  cotangent is the CONSTANT loss_seed * aux_weight / m, so
                  it seeds the backward vjp directly — no aux value rides
                  the pipeline hops.
+    rng_stacked: optional (n_layer, 2) uint32 dropout keys (layer axis
+                 sharded over pipe like `stacked`).  Each tick folds the
+                 MICROBATCH index into its stage's keys — so microbatches
+                 draw independent masks AND the backward's recompute
+                 (which folds the same j at its later tick) reproduces the
+                 forward masks bit-exactly; keys stay outside the
+                 differentiated arguments (no float0 cotangent plumbing).
 
     Returns (loss, dstacked, dhead, dx):
         loss    = loss_seed * (mean head loss + aux_weight * mean aux),
@@ -271,22 +279,32 @@ def spmd_pipeline_1f1b(
     dtype = x.dtype
     f32 = jnp.float32
 
-    def slab_fwd(loc, xi):
+    def slab_fwd(loc, xi, keys=None):
         """Local layer slab; always returns (y, aux_sum) — aux is a zero
-        scalar without `with_aux` so the vjp plumbing is uniform."""
+        scalar without `with_aux` so the vjp plumbing is uniform.  `keys`
+        (per-layer dropout keys) ride the scan xs but are NOT a vjp
+        argument — the caller closes over them per tick."""
+        xs = loc if keys is None else (loc, keys)
+
+        def merged(bp):
+            if keys is None:
+                return bp
+            w, kk = bp
+            return dict(w, dropout_rng=kk)
+
         if with_aux:
             def body(c, bp):
                 xc, a = c
-                xn, anew = block_fn(xc, bp)
+                xn, anew = block_fn(xc, merged(bp))
                 return (xn, a + anew.astype(jnp.float32)), None
             (y, aux), _ = jax.lax.scan(
-                body, (xi, jnp.zeros((), jnp.float32)), loc
+                body, (xi, jnp.zeros((), jnp.float32)), xs
             )
             return y, aux
 
         def body(c, bp):
-            return block_fn(c, bp), None
-        y, _ = jax.lax.scan(body, xi, loc)
+            return block_fn(c, merged(bp)), None
+        y, _ = jax.lax.scan(body, xi, xs)
         return y, jnp.zeros((), jnp.float32)
 
     seed = jnp.asarray(loss_seed, f32)
@@ -295,7 +313,7 @@ def spmd_pipeline_1f1b(
     if s == 1:
         # no pipeline: one explicit vjp over scan+head, same return contract
         def full(st, hp, xx):
-            y, aux = slab_fwd(st, xx)
+            y, aux = slab_fwd(st, xx, rng_stacked)
             return head_fn(hp, y, targets).astype(f32) + aw * aux
         loss, vjp = jax.vjp(full, stacked, head_params, x)
         dstacked, dhead, dx = vjp(seed)
@@ -314,8 +332,14 @@ def spmd_pipeline_1f1b(
             tmb, NamedSharding(mesh, P(None, data_axis))
         )
 
-    def local(stacked_loc, head_loc, xmb, tmb, seed):
+    def local(stacked_loc, head_loc, xmb, tmb, seed, rng_loc=None):
         stage = jax.lax.axis_index(pipe_axis)
+
+        def fold_keys(j):
+            """This stage's per-layer dropout keys for microbatch j."""
+            if rng_loc is None:
+                return None
+            return jax.vmap(lambda kk: jax.random.fold_in(kk, j))(rng_loc)
         shift_fwd = [(i, i + 1) for i in range(s - 1)]
         shift_bwd = [(i, i - 1) for i in range(1, s)]
         act_shape = xmb.shape[1:]
@@ -347,7 +371,10 @@ def spmd_pipeline_1f1b(
                 c["stash"], slot_b, 0, keepdims=False
             )
             cot = jnp.where(stage == s - 1, c["pending"], c["db"])
-            _, vjp = jax.vjp(slab_fwd, stacked_loc, x_in_b)
+            keys_b = fold_keys(jnp.clip(jb, 0, m - 1))
+            _, vjp = jax.vjp(
+                lambda l, xi: slab_fwd(l, xi, keys_b), stacked_loc, x_in_b
+            )
             # aux joins the loss as aux_weight * mean over microbatches;
             # the accumulated grads are divided by m at the end (like the
             # head path, whose per-microbatch seed is also un-divided), so
@@ -382,7 +409,7 @@ def spmd_pipeline_1f1b(
                 ),
                 c["stash"],
             )
-            y, aux_t = slab_fwd(stacked_loc, x_in_f)
+            y, aux_t = slab_fwd(stacked_loc, x_in_f, fold_keys(jf_c))
             aux_acc = c["aux"] + jnp.where(valid_f, aux_t, 0.0)
 
             # -- head: loss + dy for the microbatch leaving the last stage.
@@ -437,14 +464,19 @@ def spmd_pipeline_1f1b(
 
     specs = jax.tree.map(lambda _: P(pipe_axis), stacked)
     head_specs = jax.tree.map(lambda _: P(), head_params)
+    args = [stacked, head_params, xmb, tmb, seed]
+    in_specs = [specs, head_specs, P(), P(), P()]
+    if rng_stacked is not None:
+        args.append(rng_stacked)
+        in_specs.append(P(pipe_axis))
     loss, dslab, dhead, dx = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(specs, head_specs, P(), P(), P()),
+        in_specs=tuple(in_specs),
         out_specs=(P(), specs, head_specs, P()),
         axis_names={pipe_axis},
         check_vma=False,
-    )(stacked, head_params, xmb, tmb, seed)
+    )(*args)
     dstacked = jax.tree.map(
         lambda g, v: g.astype(v.dtype), dslab, stacked
     )
