@@ -424,6 +424,41 @@ class Test1F1B:
         with pytest.raises(NotImplementedError, match="gather_quant"):
             eng.step(state, batch(quant.config))
 
+    def test_accum_steps_compose(self):
+        """1F1B inside the engine's microbatch-accumulation scan: a
+        (2, 8, T) accumulated step matches the (16, T) one-shot step."""
+        cfg = tiny_cfg()
+        model = GPT2Model(cfg)
+        idx, tgt = batch(cfg, b=16)
+        kw = dict(pipeline_parallel=2, pipeline_microbatches=4,
+                  pipeline_schedule="1f1b")
+        e1 = Zero1(model, AdamW(lr=1e-3), **kw)
+        e2 = Zero1(model, AdamW(lr=1e-3), accum_steps=2, **kw)
+        s1 = e1.init(jax.random.PRNGKey(0))
+        s2 = e2.init(jax.random.PRNGKey(0))
+        s1, l1 = e1.step(s1, (idx, tgt))
+        s2, l2 = e2.step(
+            s2, (idx.reshape(2, 8, -1), tgt.reshape(2, 8, -1))
+        )
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+    def test_loss_scaling_compose(self):
+        """Static AMP loss scale seeds the 1F1B backward (loss_seed); the
+        unscaled result matches the unscaled run step for step."""
+        cfg = tiny_cfg()
+        model = GPT2Model(cfg)
+        b = batch(cfg)
+        kw = dict(pipeline_parallel=2, pipeline_microbatches=4,
+                  pipeline_schedule="1f1b")
+        e1 = Zero1(model, AdamW(lr=1e-3), **kw)
+        e2 = Zero1(model, AdamW(lr=1e-3), loss_scale=2.0 ** 12, **kw)
+        s1 = e1.init(jax.random.PRNGKey(0))
+        s2 = e2.init(jax.random.PRNGKey(0))
+        for _ in range(3):
+            s1, l1 = e1.step(s1, b)
+            s2, l2 = e2.step(s2, b)
+            np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+
     def test_dropout_trains_and_is_deterministic(self):
         """1F1B + dropout: keys ride outside the differentiated args,
         folded per microbatch.  Same state + same step => identical loss
