@@ -666,10 +666,6 @@ class GPT2Model:
             )
         if pctx is None or pctx.pipe_axis is None:
             raise ValueError("loss_and_grad_1f1b needs a pipeline pctx")
-        if pctx.seq_parallel:
-            raise NotImplementedError(
-                "1F1B + sequence parallel: use the GPipe schedule"
-            )
         from ..parallel.pipeline import spmd_pipeline_1f1b
 
         block, aux_w, with_aux = self._pipeline_1f1b_block(pctx)
@@ -713,6 +709,7 @@ class GPT2Model:
             loss_seed=loss_seed,
             with_aux=with_aux, aux_weight=aux_w,
             rng_stacked=drop_keys,
+            seq_axis=pctx.seq_axis,
         )
         g_embed = embed_vjp(dx.astype(x.dtype))[0]
         g_stack = stacked_vjp(dstacked)[0]

@@ -215,9 +215,10 @@ class ZeroEngine:
         pipeline_schedule: "gpipe" (default — forward-all-then-backward-all
         via autodiff, O(M) in-flight activations) or "1f1b" (combined
         fwd/bwd tick schedule, O(S) in-flight — raise microbatches to
-        amortize the bubble without the activation bill; MoE aux loss and
-        dropout supported; see pipeline.py::spmd_pipeline_1f1b for the
-        remaining restrictions: no sequence parallel, no gather_quant).
+        amortize the bubble without the activation bill; MoE aux loss,
+        dropout, and ring/Ulysses sequence parallelism all compose; the
+        one remaining restriction is gather_quant — see
+        pipeline.py::spmd_pipeline_1f1b).
 
         grad_clip: clip gradients to this global L2 norm (computed across
         every leaf; under ZeRO-2/3 the per-leaf square-sums run on the

@@ -218,6 +218,7 @@ def spmd_pipeline_1f1b(
     with_aux: bool = False,
     aux_weight: float = 0.0,
     rng_stacked=None,
+    seq_axis: Optional[str] = None,
 ):
     """1F1B-schedule pipeline: combined forward AND backward in ONE tick
     scan, bounding in-flight activations at O(S) instead of GPipe's O(M).
@@ -260,6 +261,14 @@ def spmd_pipeline_1f1b(
                  (which folds the same j at its later tick) reproduces the
                  forward masks bit-exactly; keys stay outside the
                  differentiated arguments (no float0 cotangent plumbing).
+    seq_axis:    active sequence-parallel mesh axis, or None.  Like GPipe,
+                 the shard_map then goes manual over BOTH {pipe, seq} so
+                 ring/Ulysses attention runs per-shard inside the slab
+                 (ops/attention.py pipe-parallel dispatch).  The head sees
+                 its LOCAL T/n token slice: the per-microbatch loss is the
+                 seq-pmean of local token-means, so the local head vjp is
+                 seeded loss_seed/n and dslab/dhead are seq-psummed at the
+                 end; dx stays seq-sharded like the activations.
 
     Returns (loss, dstacked, dhead, dx):
         loss    = loss_seed * (mean head loss + aux_weight * mean aux),
@@ -319,6 +328,10 @@ def spmd_pipeline_1f1b(
         dstacked, dhead, dx = vjp(seed)
         return loss * seed, dstacked, dhead, dx
 
+    sp = seq_axis if (seq_axis is not None
+                      and seq_axis in mesh.axis_names
+                      and mesh.shape[seq_axis] > 1) else None
+    n_sp = mesh.shape[sp] if sp else 1
     mb = b // m
     k = 2 * s - 1                 # stash slots: max in-flight per stage
     nt = m + 2 * s - 1            # ticks until the last backward drains
@@ -326,10 +339,10 @@ def spmd_pipeline_1f1b(
     tmb = targets.reshape(m, mb, *targets.shape[1:])
     if data_axis is not None and data_axis in mesh.axis_names:
         xmb = jax.lax.with_sharding_constraint(
-            xmb, NamedSharding(mesh, P(None, data_axis))
+            xmb, NamedSharding(mesh, P(None, data_axis, sp))
         )
         tmb = jax.lax.with_sharding_constraint(
-            tmb, NamedSharding(mesh, P(None, data_axis))
+            tmb, NamedSharding(mesh, P(None, data_axis, sp))
         )
 
     def local(stacked_loc, head_loc, xmb, tmb, seed, rng_loc=None):
@@ -378,8 +391,10 @@ def spmd_pipeline_1f1b(
             # aux joins the loss as aux_weight * mean over microbatches;
             # the accumulated grads are divided by m at the end (like the
             # head path, whose per-microbatch seed is also un-divided), so
-            # the constant aux cotangent here must NOT carry its own /m
-            dsl, dxi = vjp((cot, seed * aw))
+            # the constant aux cotangent here must NOT carry its own /m —
+            # but under seq parallel it DOES carry 1/n_sp (the loss takes
+            # the pmean of per-shard aux, and dslab is seq-psummed)
+            dsl, dxi = vjp((cot, seed * aw / n_sp))
             w_b = valid_b.astype(f32)
             dslab = jax.tree.map(
                 lambda a, g: a + w_b * g.astype(f32), c["dslab"], dsl
@@ -426,7 +441,10 @@ def spmd_pipeline_1f1b(
                     lambda hp, yy: head_fn(hp, yy, tg).astype(f32),
                     head_loc, y,
                 )
-                dhp, dy = head_vjp(seed)
+                # under seq parallel the head loss is the pmean of local
+                # token-means (pmean applied once, after the scan), so the
+                # local vjp seeds 1/n_sp of the loss cotangent
+                dhp, dy = head_vjp(seed / n_sp)
                 return (lj, jax.tree.map(lambda g: g.astype(f32), dhp),
                         dy.astype(dtype))
 
@@ -453,19 +471,34 @@ def spmd_pipeline_1f1b(
         # sub-f32 all-reduces inside manual regions, and f32 is the right
         # accumulation dtype anyway)
         loss = jax.lax.psum(c["loss"], pipe_axis) / m
-        # every stage holds its own layers' aux; the pipe-psum sums layers
-        loss = loss + seed * aw * jax.lax.psum(c["aux"], pipe_axis) / m
+        aux_total = jax.lax.psum(c["aux"], pipe_axis) / m
+        if sp:
+            # each seq shard computed local token-means (head) and aux over
+            # its own token slice: average the estimates (cf. GPipe's aux
+            # pmean); grads SUM across shards — the head vjps were seeded
+            # 1/n_sp so the psum lands exactly on d(pmean)/dparam, and the
+            # block grads inherit that scale through dy
+            loss = jax.lax.pmean(loss, sp)
+            aux_total = jax.lax.pmean(aux_total, sp)
+            dhead_c = jax.tree.map(lambda g: jax.lax.psum(g, sp),
+                                   c["dhead"])
+            dslab_c = jax.tree.map(lambda g: jax.lax.psum(g, sp),
+                                   c["dslab"])
+        else:
+            dhead_c, dslab_c = c["dhead"], c["dslab"]
+        loss = loss + seed * aw * aux_total
         dhead = jax.tree.map(
-            lambda g: jax.lax.psum(g, pipe_axis) / m, c["dhead"]
+            lambda g: jax.lax.psum(g, pipe_axis) / m, dhead_c
         )
         dx = jax.lax.psum(c["dx"], pipe_axis) / m
-        dslab = jax.tree.map(lambda g: g / m, c["dslab"])
+        dslab = jax.tree.map(lambda g: g / m, dslab_c)
         return loss, dslab, dhead, dx
 
     specs = jax.tree.map(lambda _: P(pipe_axis), stacked)
     head_specs = jax.tree.map(lambda _: P(), head_params)
+    x_spec = P(None, None, sp) if sp else P()
     args = [stacked, head_params, xmb, tmb, seed]
-    in_specs = [specs, head_specs, P(), P(), P()]
+    in_specs = [specs, head_specs, x_spec, x_spec, P()]
     if rng_stacked is not None:
         args.append(rng_stacked)
         in_specs.append(P(pipe_axis))
@@ -473,8 +506,8 @@ def spmd_pipeline_1f1b(
         local,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(P(), specs, head_specs, P()),
-        axis_names={pipe_axis},
+        out_specs=(P(), specs, head_specs, x_spec),
+        axis_names={pipe_axis} | ({sp} if sp else set()),
         check_vma=False,
     )(*args)
     dstacked = jax.tree.map(
