@@ -421,12 +421,27 @@ class Test1F1B:
         with pytest.raises(ValueError, match="pipeline_schedule"):
             Zero1(GPT2Model(tiny_cfg()), AdamW(lr=1e-3),
                   pipeline_parallel=2, pipeline_schedule="interleaved")
-        quant = GPT2Model(tiny_cfg(gather_quant="fp8"))
-        eng = Zero1(quant, AdamW(lr=1e-3), pipeline_parallel=2,
-                    pipeline_schedule="1f1b")
-        state = eng.init(jax.random.PRNGKey(0))
-        with pytest.raises(NotImplementedError, match="gather_quant"):
-            eng.step(state, batch(quant.config))
+    def test_fp8_gather_matches_gpipe(self):
+        """gather_quant="fp8" under 1F1B: the f8 stacked cotangents
+        accumulate f32 across ticks and cross the e4m3 edge once at the
+        boundary — trajectory matches the GPipe fp8 path tightly."""
+        cfg = tiny_cfg(gather_quant="fp8")
+        model = GPT2Model(cfg)
+        b = batch(cfg)
+
+        def run(schedule):
+            eng = Zero3(model, AdamW(lr=1e-3), pipeline_parallel=2,
+                        pipeline_microbatches=4,
+                        pipeline_schedule=schedule)
+            state = eng.init(jax.random.PRNGKey(0))
+            losses = []
+            for _ in range(5):
+                state, loss = eng.step(state, b)
+                losses.append(float(loss))
+            return losses
+
+        np.testing.assert_allclose(run("1f1b"), run("gpipe"),
+                                   rtol=1e-5, atol=1e-5)
 
     def test_accum_steps_compose(self):
         """1F1B inside the engine's microbatch-accumulation scan: a

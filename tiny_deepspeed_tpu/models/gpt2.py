@@ -659,11 +659,10 @@ class GPT2Model:
         the differentiated args, folded per microbatch (independent masks
         per microbatch, bit-exact backward recompute); the embedding
         dropout joins the embed vjp here."""
-        if self.config.gather_quant:
-            raise NotImplementedError(
-                "1F1B + gather_quant: quantized stacked leaves need f8 "
-                "cotangent plumbing; use the GPipe schedule"
-            )
+        # gather_quant="fp8" composes: the f8 stacked leaves' cotangents
+        # accumulate in f32 across ticks and cast to e4m3 once at the
+        # pipeline boundary — the same one-crossing precision profile as
+        # the autodiff (GPipe/plain) fp8 path, loss-curve validated there
         if pctx is None or pctx.pipe_axis is None:
             raise ValueError("loss_and_grad_1f1b needs a pipeline pctx")
         from ..parallel.pipeline import spmd_pipeline_1f1b

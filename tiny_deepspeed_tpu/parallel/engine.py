@@ -216,9 +216,8 @@ class ZeroEngine:
         via autodiff, O(M) in-flight activations) or "1f1b" (combined
         fwd/bwd tick schedule, O(S) in-flight — raise microbatches to
         amortize the bubble without the activation bill; MoE aux loss,
-        dropout, and ring/Ulysses sequence parallelism all compose; the
-        one remaining restriction is gather_quant — see
-        pipeline.py::spmd_pipeline_1f1b).
+        dropout, fp8 weight gather, and ring/Ulysses sequence
+        parallelism all compose — see pipeline.py::spmd_pipeline_1f1b).
 
         grad_clip: clip gradients to this global L2 norm (computed across
         every leaf; under ZeRO-2/3 the per-leaf square-sums run on the
