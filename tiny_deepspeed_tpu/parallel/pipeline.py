@@ -243,6 +243,16 @@ def spmd_pipeline_1f1b(
     Total ticks M + 2S - 1 — the same O(M + S) wall clock as GPipe's
     fwd+bwd pair; what changes is the memory bound, not the bubble.
 
+    Interleaved/virtual-stage scheduling (Megatron's bubble reducer) is a
+    DELIBERATE non-goal: its payoff is a smaller bubble at FIXED M, but
+    under this schedule M can simply grow — activation memory stays O(S) —
+    until the bubble (S-1)/(M+2S-2) is amortized away, which covers every
+    case where the global batch allows more microbatches.  Realizing
+    virtual stages under SPMD would also force the stacked layer axis into
+    a permuted storage layout (device s owning non-contiguous chunks
+    {s, s+S, ...}) that every non-pipelined consumer (plain scan, GPipe,
+    eval, checkpoints) would then have to unpermute per step.
+
     block_fn:    (x, block_params) -> x, or -> (x, aux scalar) with
                  `with_aux` (MoE load-balance loss).
     head_fn:     (head_params, y_mb, targets_mb) -> scalar token-mean loss.
