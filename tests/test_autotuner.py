@@ -127,6 +127,16 @@ class TestPendingLifecycle:
             state, l1 = eng.step(state, (idx, idx))  # tuned program runs
             assert float(l1) <= float(l0) + 1.0
             assert eng.retune() == 0  # idempotent: nothing left pending
+            # the guardrail counterpart: revert_tune uninstalls the tuner
+            # and rebuilds with candidate defaults; the engine keeps
+            # stepping
+            tuned_step = eng._step
+            eng.revert_tune()
+            assert eng._step is not tuned_step
+            from tiny_deepspeed_tpu.autotuner import get_default_tuner
+            assert get_default_tuner() is None
+            state, l2 = eng.step(state, (idx, idx))
+            assert float(l2) == float(l2)  # finite, program runs
         finally:
             set_default_tuner(None)
 
