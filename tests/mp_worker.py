@@ -3,7 +3,7 @@
 
 """Worker for tests/test_multiprocess.py — NOT a pytest module.
 
-Run as:  python mp_worker.py <process_id> <num_processes> <port>
+Run as:  python mp_worker.py <process_id> <num_processes> <port> [engine]
 
 Each process owns 2 virtual CPU devices; jax.distributed.initialize stitches
 them into one 4-device global backend, exercising the REAL multi-process
@@ -17,6 +17,7 @@ import os
 import sys
 
 proc_id, n_proc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+engine_name = sys.argv[4] if len(sys.argv) > 4 else "DDP"
 os.environ.pop("JAX_COORDINATOR_ADDRESS", None)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -47,7 +48,8 @@ assert jax.process_count() == n_proc, jax.process_count()
 assert len(jax.local_devices()) == 2
 assert len(jax.devices()) == 2 * n_proc
 
-from tiny_deepspeed_tpu import AdamW, DDP, GPT2Model, GPTConfig  # noqa: E402
+import tiny_deepspeed_tpu as tds  # noqa: E402
+from tiny_deepspeed_tpu import AdamW, GPT2Model, GPTConfig  # noqa: E402
 
 mesh = make_mesh()  # all 4 global devices on one "data" axis
 # 2 processes x 2 local devices: _n_granules sees distinct process_index
@@ -61,7 +63,10 @@ assert _procs[0] == _procs[1] and _procs[2] == _procs[3], _procs
 cfg = GPTConfig(block_size=16, vocab_size=64, n_layer=2, n_head=2,
                 n_embd=16, compute_dtype=jnp.float32)
 model = GPT2Model(cfg)
-eng = DDP(model, AdamW(lr=1e-3), mesh=mesh)
+# DDP: the grad all-reduce crosses the process boundary.  Zero3: params
+# LIVE sharded across the two processes and every per-layer all-gather is
+# a cross-process collective.
+eng = getattr(tds, engine_name)(model, AdamW(lr=1e-3), mesh=mesh)
 state = eng.init(jax.random.PRNGKey(0))
 
 # global batch (B=8, T=16): same numpy stream on every process, each feeds
@@ -83,5 +88,6 @@ for _ in range(2):
     losses.append(float(loss))
 
 print(json.dumps({"process": proc_id, "losses": losses,
+                  "engine": engine_name,
                   "devices": len(jax.devices())}), flush=True)
 jax.distributed.shutdown()

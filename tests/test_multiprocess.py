@@ -34,14 +34,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_ddp_step():
+@pytest.mark.parametrize("engine", ["DDP", "Zero3"])
+def test_two_process_step(engine):
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(HERE, "mp_worker.py"),
-             str(i), "2", str(port)],
+             str(i), "2", str(port), engine],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
@@ -80,11 +81,13 @@ def test_two_process_ddp_step():
         "jax.config.update('jax_platforms', 'cpu');"
         "jax.config.update('jax_num_cpu_devices', 4);"
         "import jax.numpy as jnp;"
-        "from tiny_deepspeed_tpu import AdamW, DDP, GPT2Model, GPTConfig;"
+        "from tiny_deepspeed_tpu import AdamW, GPT2Model, GPTConfig;"
         "from tiny_deepspeed_tpu.parallel.mesh import make_mesh;"
         "cfg = GPTConfig(block_size=16, vocab_size=64, n_layer=2, n_head=2,"
         "                n_embd=16, compute_dtype=jnp.float32);"
-        "eng = DDP(GPT2Model(cfg), AdamW(lr=1e-3), mesh=make_mesh());"
+        "import tiny_deepspeed_tpu as tds;"
+        "eng = getattr(tds, %r)(GPT2Model(cfg), AdamW(lr=1e-3),"
+        "                       mesh=make_mesh());"
         "state = eng.init(jax.random.PRNGKey(0));"
         "rng = np.random.default_rng(0);"
         "idx = jnp.asarray(rng.integers(0, 64, (8, 16), dtype=np.int32));"
@@ -94,7 +97,7 @@ def test_two_process_ddp_step():
         "    state, loss = eng.step(state, (idx, tgt))\n"
         "    losses.append(float(loss))\n"
         "print(json.dumps(losses))"
-    ) % os.path.dirname(HERE)
+    ) % (os.path.dirname(HERE), engine)
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, timeout=300, env=env)
     assert r.returncode == 0, r.stderr[-2000:]
