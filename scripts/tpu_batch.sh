@@ -1,0 +1,46 @@
+#!/bin/bash
+# The round-3 TPU measurement batch (VERDICT items 1-3, 7-8): run the
+# moment the tunnel answers, most-important first, each step tolerant of
+# the tunnel dying again mid-batch.  Everything tees into $OUT.
+cd "$(dirname "$0")/.." || exit 1
+OUT="${TPU_BATCH_OUT:-/tmp/tpu_batch}"
+mkdir -p "$OUT"
+log() { echo "[tpu_batch $(date -u +%H:%M:%S)] $*" | tee -a "$OUT/batch.log"; }
+
+log "1. default bench (populates .bench_last_good.json)"
+timeout 2400 python bench.py > "$OUT/bench_default.json" 2> "$OUT/bench_default.err"
+log "   rc=$? $(cat "$OUT/bench_default.json" 2>/dev/null | head -c 200)"
+
+log "2. autotuned bench (guardrail keeps the faster program)"
+timeout 3000 env BENCH_AUTOTUNE=1 python bench.py > "$OUT/bench_autotune.json" 2> "$OUT/bench_autotune.err"
+log "   rc=$? $(cat "$OUT/bench_autotune.json" 2>/dev/null | head -c 200)"
+
+log "3. 124M b=12 retest"
+timeout 2400 env BENCH_BATCH=12 python bench.py > "$OUT/bench_b12.json" 2> "$OUT/bench_b12.err"
+log "   rc=$? $(cat "$OUT/bench_b12.json" 2>/dev/null | head -c 200)"
+
+log "4. sweep (350m/774m/1.5b/llama-160m/moe-8x124m rows)"
+timeout 5400 python bench.py --sweep > "$OUT/bench_sweep.jsonl" 2> "$OUT/bench_sweep.err"
+log "   rc=$? rows=$(wc -l < "$OUT/bench_sweep.jsonl" 2>/dev/null)"
+
+log "5. decode throughput"
+timeout 1800 env BENCH_DECODE=1 python bench.py > "$OUT/bench_decode.json" 2> "$OUT/bench_decode.err"
+log "   rc=$? $(cat "$OUT/bench_decode.json" 2>/dev/null | head -c 200)"
+
+log "6. long context T=4096 (B=2)"
+timeout 2400 env BENCH_SEQ=4096 BENCH_BATCH=2 python bench.py > "$OUT/bench_t4096.json" 2> "$OUT/bench_t4096.err"
+log "   rc=$? $(cat "$OUT/bench_t4096.json" 2>/dev/null | head -c 200)"
+
+log "7. long context T=8192 (B=1)"
+timeout 2400 env BENCH_SEQ=8192 BENCH_BATCH=1 python bench.py > "$OUT/bench_t8192.json" 2> "$OUT/bench_t8192.err"
+log "   rc=$? $(cat "$OUT/bench_t8192.json" 2>/dev/null | head -c 200)"
+
+log "8. offload execution test (TPU-gated)"
+timeout 1200 python -m pytest tests/test_offload.py -q > "$OUT/offload.log" 2>&1
+log "   rc=$? $(tail -1 "$OUT/offload.log")"
+
+log "9. offload bench (1.5b HBM delta)"
+timeout 2400 env BENCH_OFFLOAD=1 BENCH_MODEL=gpt2-1.5b python bench.py > "$OUT/bench_offload.json" 2> "$OUT/bench_offload.err"
+log "   rc=$? $(cat "$OUT/bench_offload.json" 2>/dev/null | head -c 200)"
+
+log "batch complete; results in $OUT"

@@ -10,12 +10,9 @@ while true; do
   n=$((n + 1))
   if timeout 75 python -c "import jax; print(jax.devices())" 2>/dev/null; then
     echo "tpu_watch: tunnel UP after $n probes, $(( $(date +%s) - start ))s"
-    # measure IMMEDIATELY while it's up: default bench populates
-    # .bench_last_good.json (the round-end outage insurance)
-    cd "$(dirname "$0")/.." || exit 0
-    timeout 2400 python bench.py > /tmp/bench_up.json 2> /tmp/bench_up.err
-    echo "tpu_watch: bench rc=$? -> /tmp/bench_up.json"
-    cat /tmp/bench_up.json
+    # measure IMMEDIATELY while it's up: the full round-3 batch, most
+    # important (default bench -> .bench_last_good.json) first
+    bash "$(dirname "$0")/tpu_batch.sh"
     exit 0
   fi
   now=$(date +%s)
