@@ -39,6 +39,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _active_axis(mesh: Mesh, name: Optional[str]) -> Optional[str]:
+    """`name` when it is a real (>1-way) mesh axis, else None — the seq
+    handshake both pipeline schedules share."""
+    return name if (name is not None and name in mesh.axis_names
+                    and mesh.shape[name] > 1) else None
+
+
 def spmd_pipeline(
     block_fn,
     stacked,
@@ -106,9 +113,7 @@ def spmd_pipeline(
     boundary_dtype = (
         jnp.float32 if jax.default_backend() == "cpu" else dtype
     )
-    sp = seq_axis if (seq_axis is not None
-                      and seq_axis in mesh.axis_names
-                      and mesh.shape[seq_axis] > 1) else None
+    sp = _active_axis(mesh, seq_axis)
     xmb = x.reshape(m, b // m, *x.shape[1:]).astype(boundary_dtype)
     if data_axis is not None and data_axis in mesh.axis_names:
         xmb = jax.lax.with_sharding_constraint(
@@ -311,20 +316,18 @@ def spmd_pipeline_1f1b(
             w, kk = bp
             return dict(w, dropout_rng=kk)
 
-        if with_aux:
-            def body(c, bp):
-                xc, a = c
-                xn, anew = block_fn(xc, merged(bp))
-                return (xn, a + anew.astype(jnp.float32)), None
-            (y, aux), _ = jax.lax.scan(
-                body, (xi, jnp.zeros((), jnp.float32)), xs
-            )
-            return y, aux
-
         def body(c, bp):
-            return block_fn(c, merged(bp)), None
-        y, _ = jax.lax.scan(body, xi, xs)
-        return y, jnp.zeros((), jnp.float32)
+            xc, a = c
+            out = block_fn(xc, merged(bp))
+            if with_aux:
+                xn, anew = out
+                return (xn, a + anew.astype(jnp.float32)), None
+            return (out, a), None
+
+        (y, aux), _ = jax.lax.scan(
+            body, (xi, jnp.zeros((), jnp.float32)), xs
+        )
+        return y, aux
 
     seed = jnp.asarray(loss_seed, f32)
     aw = jnp.float32(aux_weight)
@@ -338,9 +341,7 @@ def spmd_pipeline_1f1b(
         dstacked, dhead, dx = vjp(seed)
         return loss * seed, dstacked, dhead, dx
 
-    sp = seq_axis if (seq_axis is not None
-                      and seq_axis in mesh.axis_names
-                      and mesh.shape[seq_axis] > 1) else None
+    sp = _active_axis(mesh, seq_axis)
     n_sp = mesh.shape[sp] if sp else 1
     mb = b // m
     k = 2 * s - 1                 # stash slots: max in-flight per stage
