@@ -10,6 +10,27 @@ log() { echo "[tpu_batch $(date -u +%H:%M:%S)] $*" | tee -a "$OUT/batch.log"; }
 log "1. default bench (populates .bench_last_good.json)"
 timeout 2400 python bench.py > "$OUT/bench_default.json" 2> "$OUT/bench_default.err"
 log "   rc=$? $(cat "$OUT/bench_default.json" 2>/dev/null | head -c 200)"
+# commit the measurement IMMEDIATELY: the committed last-good file is the
+# round-boundary outage insurance (bench.py replays it, stale-labeled, when
+# the tunnel is down for a whole round — the rounds 1-3 failure mode).
+# Gate on THIS run's output being a fresh chip measurement (value > 0 and
+# not itself a cache replay), and log only if the commit really landed.
+if python -c "
+import json, sys
+try:
+    r = json.load(open('$OUT/bench_default.json'))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if r.get('value', 0) > 0
+         and not r.get('extra', {}).get('cached_result') else 1)"; then
+  if git add .bench_last_good.json && \
+     git commit -m "Record measured TPU bench (last-good cache)" \
+       --only .bench_last_good.json >> "$OUT/batch.log" 2>&1; then
+    log "   committed fresh .bench_last_good.json"
+  else
+    log "   last-good unchanged; nothing committed"
+  fi
+fi
 
 log "2. autotuned bench (guardrail keeps the faster program)"
 timeout 3000 env BENCH_AUTOTUNE=1 python bench.py > "$OUT/bench_autotune.json" 2> "$OUT/bench_autotune.err"

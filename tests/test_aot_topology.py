@@ -1,0 +1,98 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""TPU-topology AOT compilation: the round-4 evidence locked as tests.
+
+These compile the REAL engine step against a compile-only v5e topology
+(no hardware; libtpu compiles locally) and assert the three properties the
+round-3 verdict called assertions:
+
+  * ZeRO-2/3 grads realize as TRUE ring reduce-scatter kernels
+    (`AllReduceScatterFusion`), not the CPU backend's all-reduce + slice;
+  * collectives schedule asynchronously (start/done structure), the
+    compiled form of the engine's overlap claim (engine.py:14-18);
+  * the collective ledger's TPU-format parsing (fusion-wrapped collectives,
+    layout-annotated constants, done-half dedup) agrees with comm_report.
+
+Slow (~1 min: two TPU compiles); marked `slow`, excluded from `-m quick`.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from tiny_deepspeed_tpu import AdamW, GPT2Model, GPTConfig, Zero2, Zero3
+from tiny_deepspeed_tpu.utils.hlo_comm import collective_ledger
+from tiny_deepspeed_tpu.utils.profiling import comm_report
+
+pytestmark = pytest.mark.slow
+
+# the abstract-state/batch builders live in the script (single copy)
+_spec = importlib.util.spec_from_file_location(
+    "aot_topology_script",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "scripts", "aot_topology.py"),
+)
+_aot = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_aot)
+
+
+@pytest.fixture(scope="module")
+def topo_mesh():
+    from jax.experimental import topologies
+    from jax.sharding import Mesh
+
+    try:
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:4x2"
+        )
+    except Exception as e:  # no libtpu in some environments
+        pytest.skip(f"TPU topology unavailable: {e}")
+    return Mesh(np.array(topo.devices).reshape(8), ("data",))
+
+
+CFG = GPTConfig(block_size=128, vocab_size=512, n_layer=4, n_head=8,
+                n_embd=256)
+
+
+def _compiled_text(engine, b=8, t=128):
+    state = _aot._state_structs(engine)
+    batch = _aot._batch_structs(engine, b, t)
+    return engine._step.lower(state, batch).compile().as_text()
+
+
+class TestTpuTopologyHLO:
+    def test_zero2_true_reduce_scatter_and_ledger_agreement(self, topo_mesh):
+        eng = Zero2(GPT2Model(CFG), AdamW(lr=1e-3), mesh=topo_mesh)
+        text = _compiled_text(eng)
+        # ring reduce-scatter kernels, not all-reduce + slice
+        assert "AllReduceScatterFusion" in text
+        led = collective_ledger(text)
+        assert led["wire_bytes"].get("reduce-scatter", 0) > 0
+        assert not led["unresolved_loops"], led["unresolved_loops"]
+        # grads dominate: the all-reduce residue must stay tiny
+        assert led["wire_bytes"].get("all-reduce", 0) < \
+            0.05 * led["wire_bytes"]["reduce-scatter"]
+        # async scheduling evidence (overlap): tagged async collectives
+        assert text.count("async_collective_name") >= 4
+        # TPU-format parsing agrees with the ring formulas end-to-end
+        predicted = comm_report(eng)["total_bytes_per_step"]
+        assert abs(led["total_wire_bytes"] - predicted) <= 0.05 * predicted, \
+            (led["total_wire_bytes"], predicted)
+
+    def test_zero3_layer_gathers_async_and_counted(self, topo_mesh):
+        eng = Zero3(GPT2Model(CFG), AdamW(lr=1e-3), mesh=topo_mesh)
+        text = _compiled_text(eng)
+        led = collective_ledger(text)
+        assert not led["unresolved_loops"], led["unresolved_loops"]
+        # per-layer gathers present and loop-multiplied; remat-bwd
+        # re-gathers put the measured bytes ABOVE the 2x-block model but
+        # below 2x of it (PROFILE.md finding 5 pins the window)
+        predicted = comm_report(eng)["zero3_layer_gather_bytes"]
+        ag = led["wire_bytes"].get("all-gather", 0)
+        assert predicted <= ag <= 2.0 * predicted, (ag, predicted)
+        # the gathers are issued as async start fusions (overlap evidence)
+        assert "%async-collective-start" in text or \
+            "async_collective_name" in text

@@ -29,7 +29,7 @@ def bench(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "LAST_GOOD",
                         str(tmp_path / "last_good.json"))
     for var in ("BENCH_BATCH", "BENCH_SEQ", "BENCH_DECODE", "BENCH_MODEL",
-                "BENCH_ATTEMPT", "BENCH_OFFLOAD"):
+                "BENCH_ATTEMPT", "BENCH_OFFLOAD", "BENCH_AUTOTUNE"):
         monkeypatch.delenv(var, raising=False)
     return mod
 
@@ -104,12 +104,49 @@ class TestCache:
         rec = {"metric": "gpt2-124m_train_tokens_per_sec_per_chip",
                "value": 1.0, "unit": "tokens/s/chip", "vs_baseline": 1.0}
         bench._save_last_good(rec)
-        got = bench._load_last_good()
+        got, stale = bench._load_last_good()
         assert got["value"] == 1.0 and got["measured_commit"]
+        assert not stale
+        # past the age window the record STILL loads (round-boundary
+        # insurance) but is flagged stale for honest labeling
         saved = json.load(open(bench.LAST_GOOD))
         saved["measured_at_epoch"] = time.time() - bench.MAX_CACHE_AGE_S - 1
         json.dump(saved, open(bench.LAST_GOOD, "w"))
+        got, stale = bench._load_last_good()
+        assert got["value"] == 1.0 and stale
+
+    def test_stale_replay_is_labeled(self, bench, capsys, monkeypatch):
+        """A round-long outage replays the committed measurement with
+        stale_cached_result + age_hours — never a silent fresh-looking
+        number, never 0.0 (the round-1..3 failure)."""
+        bench._save_last_good({
+            "metric": "gpt2-124m_train_tokens_per_sec_per_chip",
+            "value": 88000.0, "unit": "tokens/s/chip", "vs_baseline": 1.0,
+        })
+        saved = json.load(open(bench.LAST_GOOD))
+        saved["measured_at_epoch"] = time.time() - bench.MAX_CACHE_AGE_S - 60
+        json.dump(saved, open(bench.LAST_GOOD, "w"))
+        monkeypatch.setenv("BENCH_ATTEMPT", str(bench.MAX_ATTEMPTS))
+        rec = _diagnose(bench, RuntimeError("UNAVAILABLE: hung"), capsys)
+        assert rec["value"] == 88000.0
+        assert rec["extra"]["stale_cached_result"] is True
+        assert rec["extra"]["age_hours"] >= 14
+        assert "note" in rec["extra"]
+
+    def test_fingerprint_mismatch_never_replays(self, bench, capsys,
+                                                monkeypatch):
+        """A record saved under BENCH_AUTOTUNE must not replay as the
+        default config's measurement (round-3 advice)."""
+        monkeypatch.setenv("BENCH_AUTOTUNE", "1")
+        bench._save_last_good({
+            "metric": "gpt2-124m_train_tokens_per_sec_per_chip",
+            "value": 99000.0, "unit": "tokens/s/chip", "vs_baseline": 1.0,
+        })
+        monkeypatch.delenv("BENCH_AUTOTUNE")
         assert bench._load_last_good() is None
+        monkeypatch.setenv("BENCH_ATTEMPT", str(bench.MAX_ATTEMPTS))
+        rec = _diagnose(bench, RuntimeError("UNAVAILABLE: hung"), capsys)
+        assert rec["value"] == 0.0
 
     def test_default_config_predicate(self, bench, monkeypatch):
         assert bench._default_config()
@@ -117,6 +154,12 @@ class TestCache:
         assert not bench._default_config()
         monkeypatch.delenv("BENCH_OFFLOAD")
         monkeypatch.setenv("BENCH_BATCH", "12")
+        assert not bench._default_config()
+        monkeypatch.delenv("BENCH_BATCH")
+        monkeypatch.setenv("BENCH_AUTOTUNE", "1")
+        assert not bench._default_config()
+        monkeypatch.delenv("BENCH_AUTOTUNE")
+        monkeypatch.setenv("BENCH_MODEL", "gpt2-1.5b")
         assert not bench._default_config()
 
     def test_vs_prev_round_reads_latest_nonzero(self, bench, monkeypatch,

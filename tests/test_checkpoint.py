@@ -76,3 +76,62 @@ class TestCheckpoint:
         assert latest_step(str(tmp_path)) is None
         with pytest.raises(FileNotFoundError):
             load_checkpoint(str(tmp_path))
+
+    def test_resume_preserves_dropout_stream(self, tmp_path):
+        """The dropout base key rides the TrainState through a checkpoint:
+        a restored state stepping on a FRESH engine (no init call) draws the
+        ORIGINAL seed's mask stream — bit-exact with the uninterrupted run.
+        (Round-3 advice: the base used to be a jit closure constant set only
+        in init(), so resume-without-init replayed a hard-coded stream.)"""
+        cfg = GPTConfig(
+            block_size=32, vocab_size=128, n_layer=2, n_head=2, n_embd=32,
+            compute_dtype=jnp.float32, dropout=0.2,
+        )
+        model = GPT2Model(cfg)
+        eng = Zero2(model, AdamW(lr=1e-3))
+
+        s = eng.init(jax.random.PRNGKey(7))
+        assert s.dropout_base is not None
+        for i in range(3):
+            s, loss_ref = eng.step(s, batch(i))
+
+        s2 = eng.init(jax.random.PRNGKey(7))
+        s2, _ = eng.step(s2, batch(0))
+        save_checkpoint(str(tmp_path), s2, step=1)
+
+        eng2 = Zero2(GPT2Model(cfg), AdamW(lr=1e-3))  # no init() call
+        s3 = load_checkpoint(str(tmp_path), eng2)
+        for i in range(1, 3):
+            s3, loss_res = eng2.step(s3, batch(i))
+        assert float(loss_ref) == float(loss_res)
+
+        # and two different seeds draw two different mask streams
+        sA = eng.init(jax.random.PRNGKey(1))
+        sB = eng.init(jax.random.PRNGKey(2))
+        assert not np.array_equal(
+            np.asarray(sA.dropout_base), np.asarray(sB.dropout_base)
+        )
+
+    def test_legacy_checkpoint_without_dropout_base_restores(self, tmp_path):
+        """A checkpoint saved before the dropout base moved into TrainState
+        (no dropout_base leaf) still restores into a dropout-active engine:
+        the loader falls back to the legacy fixed base with a warning."""
+        import dataclasses
+        import warnings as _warnings
+
+        cfg = GPTConfig(
+            block_size=32, vocab_size=128, n_layer=2, n_head=2, n_embd=32,
+            compute_dtype=jnp.float32, dropout=0.2,
+        )
+        eng = Zero2(GPT2Model(cfg), AdamW(lr=1e-3))
+        s = eng.init(jax.random.PRNGKey(0))
+        legacy = dataclasses.replace(s, dropout_base=None)  # old format
+        save_checkpoint(str(tmp_path), legacy, step=1)
+
+        with _warnings.catch_warnings(record=True) as w:
+            _warnings.simplefilter("always")
+            restored = load_checkpoint(str(tmp_path), eng)
+        assert any("dropout_base" in str(x.message) for x in w)
+        assert restored.dropout_base is not None
+        restored, loss = eng.step(restored, batch(0))
+        assert float(loss) > 0

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute composition suite (see pytest.ini)
+
 from tiny_deepspeed_tpu import (
     AdamW, GPTConfig, GPT2Model, LlamaConfig, LlamaModel, MoEConfig, MoEGPT,
     SingleDevice, Zero3,

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute composition suite (see pytest.ini)
+
 from tiny_deepspeed_tpu import (
     AdamW, DDP, SGD, SingleDevice, Zero2, Zero3, LlamaConfig, LlamaModel,
 )

@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute composition suite (see pytest.ini)
+
 from tiny_deepspeed_tpu import GPTConfig, GPT2Model
 
 

@@ -25,6 +25,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute composition suite (see pytest.ini)
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 

@@ -15,6 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute composition suite (see pytest.ini)
+
 from tiny_deepspeed_tpu import AdamW, GPTConfig, GPT2Model, SingleDevice
 
 TINY = GPTConfig(block_size=32, vocab_size=128, n_layer=2, n_head=2,

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute composition suite (see pytest.ini)
+
 from tiny_deepspeed_tpu import (
     AdamW, DDP, GPT2Model, GPTConfig, SingleDevice, Zero1, Zero2, Zero3,
     make_mesh,

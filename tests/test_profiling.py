@@ -104,6 +104,62 @@ class TestCommReportVsCompiledHLO:
         lo = rep["grad_reduce_scatter_bytes"]
         assert lo - 128 <= grad_wire <= 2 * lo + 256, (grad_wire, lo)
 
+    def test_trip_count_prefers_root_compare_operand(self):
+        """Round-3 advice: an unrelated larger constant in the while
+        condition (e.g. a clamp bound) must not inflate the loop
+        multiplier.  The bound is the ROOT compare's constant operand;
+        conditions where no operand resolves and constants disagree are
+        flagged unresolved, not silently maxed."""
+        from tiny_deepspeed_tpu.utils.hlo_comm import _trip_count
+
+        cond = [
+            "  %c4 = s32[] constant(4)",
+            "  %c99 = s32[] constant(99)",  # unrelated clamp bound
+            "  %iv = s32[] get-tuple-element(%arg), index=0",
+            "  %clamped = s32[] minimum(%iv, %c99)",
+            "  ROOT %cmp = pred[] compare(s32[] %iv, s32[] %c4),"
+            " direction=LT",
+        ]
+        assert _trip_count(cond) == (4, True)
+
+        # TPU print format: layout annotations on constants AND compare
+        # operands ("{:T(128)}" contains parens — a first-')' capture
+        # truncates mid-annotation and resolves nothing)
+        tpu_cond = [
+            "  %c4 = s32[]{:T(128)} constant(4)",
+            "  %c99 = s32[]{:T(128)} constant(99)",
+            "  %iv = s32[]{:T(128)} get-tuple-element(%arg), index=0",
+            "  ROOT %cmp = pred[]{:T(256)} compare(s32[]{:T(128)} %iv,"
+            " s32[]{:T(128)} %c4), direction=LT, metadata={op_name=\"x\"}",
+        ]
+        assert _trip_count(tpu_cond) == (4, True)
+
+        ambiguous = [
+            "  %c4 = s32[] constant(4)",
+            "  %c99 = s32[] constant(99)",
+            "  ROOT %cmp = pred[] compare(s32[] %a, s32[] %b),"
+            " direction=LT",
+        ]
+        trips, resolved = _trip_count(ambiguous)
+        assert not resolved
+
+        # ROOT compare with a DYNAMIC bound: the lone clamp constant must
+        # not be promoted to a trip count (flagged unresolved instead)
+        dynamic = [
+            "  %c99 = s32[] constant(99)",
+            "  %bound = s32[] get-tuple-element(%arg), index=1",
+            "  ROOT %cmp = pred[] compare(%iv, %bound), direction=LT",
+        ]
+        trips, resolved = _trip_count(dynamic)
+        assert not resolved
+
+        # no ROOT compare found at all: agreeing constants still resolve
+        agreeing = [
+            "  %c8 = s32[] constant(8)",
+            "  ROOT %cmp = pred[] unusual-op(s32[] %a, s32[] %b)",
+        ]
+        assert _trip_count(agreeing) == (8, True)
+
     def test_zero3_layer_gathers_match(self):
         rep, led = self._ledger(Zero3)
         # per-layer gathers: 2x block params (fwd + remat bwd) + 1x

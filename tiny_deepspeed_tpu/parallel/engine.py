@@ -81,6 +81,13 @@ try:
         # engine runs with loss_scale="dynamic"; None (no pytree leaves)
         # otherwise, so existing states/checkpoints keep their structure
         scaler: Any = None
+        # dropout mask stream base key (derived from the init seed) when the
+        # model has dropout > 0; None otherwise.  Carried in the STATE — not
+        # as a jit closure constant — so checkpoint-restore resumes the
+        # original run's mask stream without re-init (round-3 advice: a
+        # restored state stepping on a fresh engine replayed the
+        # constructor's hard-coded base)
+        dropout_base: Any = None
 except Exception:  # pragma: no cover - flax always present in this image
     TrainState = None
 
@@ -335,10 +342,6 @@ class ZeroEngine:
         self._dropout_active = bool(
             getattr(getattr(model, "config", None), "dropout", 0.0)
         )
-        # base key for dropout masks; re-derived from the user's init key in
-        # init() so seeded runs draw different masks (round-2 advice: a
-        # hard-coded base replayed identical masks across all seeds)
-        self._dropout_base = jax.random.PRNGKey(0xD0)
         self.grad_clip = float(grad_clip) if grad_clip else None
         if loss_scale is not None and loss_scale != "dynamic" \
                 and not isinstance(loss_scale, (int, float)):
@@ -456,6 +459,9 @@ class ZeroEngine:
              "good": NamedSharding(mesh, P())}
             if self.loss_scale == "dynamic" else None
         )
+        self._dropout_shardings = (
+            NamedSharding(mesh, P()) if self._dropout_active else None
+        )
 
         if self.data_parallel:
             batch_spec = P("data", self.seq_axis)  # (B, T): tokens shard too
@@ -493,6 +499,7 @@ class ZeroEngine:
                     params=self._param_shardings,
                     opt_state=self._opt_shardings,
                     scaler=self._scaler_shardings,
+                    dropout_base=self._dropout_shardings,
                 ),
                 (self._batch_sharding, self._batch_sharding),
             ),
@@ -501,6 +508,7 @@ class ZeroEngine:
                     params=self._param_shardings,
                     opt_state=self._opt_shardings,
                     scaler=self._scaler_shardings,
+                    dropout_base=self._dropout_shardings,
                 ),
                 NamedSharding(self.mesh, P()),
             ),
@@ -550,14 +558,6 @@ class ZeroEngine:
         """Create params + optimizer state directly in their resting
         shardings (no full-replica materialization step — fixes the
         reference's full `.to(rank)` before wrapping, zero1/train.py:34)."""
-        # derive the dropout base from the user's key (NOT the same stream
-        # as param init) so seeded runs draw distinct mask sequences; the
-        # base is a closure constant of the jitted step, so rebuild it —
-        # otherwise a re-init with a new seed would silently replay the
-        # mask stream the old executable baked in
-        if self._dropout_active:
-            self._dropout_base = jax.random.fold_in(key, 0xD0)
-            self._build_step()
         params = jax.jit(
             self.model.init, out_shardings=self._param_shardings
         )(key)
@@ -571,7 +571,17 @@ class ZeroEngine:
                  "good": jnp.zeros((), jnp.int32)},
                 self._scaler_shardings,
             )
-        return TrainState(params=params, opt_state=opt_state, scaler=scaler)
+        # dropout base derived from the user's key (NOT the same stream as
+        # param init) so seeded runs draw distinct mask sequences; lives in
+        # the state (not a closure constant), so re-init with a new seed and
+        # checkpoint restore both get the right stream with no re-jit
+        dropout_base = None
+        if self._dropout_active:
+            dropout_base = jax.device_put(
+                jax.random.fold_in(key, 0xD0), self._dropout_shardings
+            )
+        return TrainState(params=params, opt_state=opt_state, scaler=scaler,
+                          dropout_base=dropout_base)
 
     # -- the train step ----------------------------------------------------
 
@@ -593,7 +603,7 @@ class ZeroEngine:
             scale = None
 
         rng = (
-            jax.random.fold_in(self._dropout_base, state.opt_state["step"])
+            jax.random.fold_in(state.dropout_base, state.opt_state["step"])
             if self._dropout_active else None
         )
 
@@ -721,7 +731,7 @@ class ZeroEngine:
         new_params = self._constrain(new_params, self._param_shardings)
         return (
             TrainState(params=new_params, opt_state=new_opt,
-                       scaler=new_scaler),
+                       scaler=new_scaler, dropout_base=state.dropout_base),
             loss,
         )
 

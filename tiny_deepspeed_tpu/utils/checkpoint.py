@@ -15,6 +15,7 @@ materialization on any single host).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 from typing import Optional
 
@@ -78,10 +79,31 @@ def load_checkpoint(directory: str, engine=None, step: Optional[int] = None,
             params=engine._param_shardings,
             opt_state=engine._opt_shardings,
             scaler=engine._scaler_shardings,
+            dropout_base=engine._dropout_shardings,
         )
         target = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             shapes,
             shardings,
         )
+        state = _checkpointer().restore(path, target)
+        if engine._dropout_shardings is not None \
+                and state.dropout_base is None:
+            # legacy checkpoint (saved before the dropout base moved into
+            # TrainState): Orbax fills the absent leaf with None, which
+            # would crash the first step.  Fall back to the fixed base the
+            # old engine replayed after restore — identical masks to
+            # resuming on the old code, just not seed-derived.
+            import warnings
+            warnings.warn(
+                "checkpoint has no dropout_base (pre-round-4 format); "
+                "using the legacy fixed mask-stream base — re-save to "
+                "upgrade",
+                stacklevel=2,
+            )
+            base = jax.device_put(
+                jax.random.PRNGKey(0xD0), engine._dropout_shardings
+            )
+            state = dataclasses.replace(state, dropout_base=base)
+        return state
     return _checkpointer().restore(path, target)

@@ -55,6 +55,25 @@ _WHILE_RE = re.compile(
     r"while\(.*?\)?, condition=%?([\w\.\-]+), body=%?([\w\.\-]+)"
 )
 _CALL_RE = re.compile(r"to_apply=%?([\w\.\-]+)")
+# fusion call edge (TPU backend): collectives on TPU live INSIDE fusion
+# computations — plain `calls=%fused_computation.N` wrappers (async
+# collective starts among them) and kCustom collective-fusion kernels.
+# The latter's `calls=%all-reduce-scatter.N` IS the TPU reduce-scatter:
+# a ring kernel fusing all-reduce + scatter (backend_config emitter
+# "AllReduceScatterFusion", StrategyRing), printed as an inner all-reduce
+# + slice.  It is classified from the fusion line (payload = the fusion's
+# OUTPUT, the shard) and NOT walked into — counting the inner all-reduce
+# would price the ring at 2x its real wire bytes.
+_FUSION_CALL_RE = re.compile(r"\bcalls=%?([\w\.\-]+)")
+_RS_FUSION_PREFIX = "all-reduce-scatter"
+# async halves: the TPU scheduler splits one logical collective into a
+# start fusion (kCustom, the op overlapped with neighboring compute — its
+# ROOT is a tuple carrying the in-flight buffers) and a done fusion whose
+# ROOT is a custom-call consuming the same printed collective op.  Only the
+# start half is a wire transfer; the done half is a completion marker and
+# must not double the ledger.  (channel_id alone cannot dedup: XLA reuses
+# a channel across legitimate clones of one logical op, e.g. peeled loop
+# iterations.)
 _BRANCH_RE = re.compile(r"branch_computations=\{([^}]*)\}")
 _TRUE_FALSE_RE = re.compile(
     r"(?:true_computation|false_computation)=%?([\w\.\-]+)"
@@ -92,16 +111,66 @@ def _split_computations(text: str) -> Dict[str, List[str]]:
     return comps
 
 
+# the optional {...} after the shape is a TPU layout annotation
+# (e.g. "s32[]{:T(128)} constant(4)")
+_CONST_DEF_RE = re.compile(
+    r"%?([\w\.\-]+)\s*=\s*s32\[\](?:\{[^}]*\})?\s+constant\((\d+)\)"
+)
+# operands may carry layout annotations containing parens ("s32[]{:T(128)}
+# %iv"), so "up to the first ')'" truncates mid-annotation; compare ops
+# always print ", direction=" after the close paren — anchor on that, with
+# the paren-free form as fallback
+_COMPARE_ARGS_RE = re.compile(
+    r"\bcompare\((.*)\),\s*direction=|\bcompare\(([^)]*)\)"
+)
+
+
 def _trip_count(cond_lines: List[str]) -> Tuple[int, bool]:
     """(static trip count, resolved?) of a while loop, from its condition
-    computation: the bound is the (usually unique) integer constant the
-    induction variable compares against.  (1, False) when no constant is
-    found — an undercount the caller flags in `unresolved_loops`."""
-    consts = []
+    computation: the bound is the integer constant the induction variable
+    compares against in the ROOT compare.  Resolution order (round-3
+    advice: "max constant anywhere" silently inflated the multiplier when
+    the condition carried an unrelated larger constant, e.g. a clamp
+    bound):
+      1. a constant that is an operand of the ROOT compare;
+      2. otherwise, a condition whose constants all agree is unambiguous;
+      3. otherwise (0 constants, or several distinct non-operand ones):
+         (max-or-1, False) — the caller flags it in `unresolved_loops`
+         so tests catch the ambiguity instead of trusting the total."""
+    consts: Dict[str, int] = {}
     for ln in cond_lines:
-        for m in re.finditer(r"s32\[\]\s+constant\((\d+)\)", ln):
-            consts.append(int(m.group(1)))
-    return (max(consts), True) if consts else (1, False)
+        for m in _CONST_DEF_RE.finditer(ln):
+            consts[m.group(1)] = int(m.group(2))
+    root_compare_seen = False
+    for ln in cond_lines:
+        s = ln.strip()
+        if not s.startswith("ROOT"):
+            continue
+        cm = _COMPARE_ARGS_RE.search(s)
+        if not cm:
+            continue
+        root_compare_seen = True
+        args = cm.group(1) if cm.group(1) is not None else cm.group(2)
+        # layout braces ("{1,0:T(8,128)}") contain commas; strip before split
+        args = re.sub(r"\{[^}]*\}", "", args)
+        operand_vals = []
+        for arg in args.split(","):
+            arg = arg.strip()
+            if not arg:
+                continue
+            name = arg.split()[-1].lstrip("%")
+            if name in consts:
+                operand_vals.append(consts[name])
+        if len(operand_vals) == 1:
+            return operand_vals[0], True
+    if root_compare_seen:
+        # the bound is dynamic (no constant operand): any constant in the
+        # condition is unrelated — never promote it to a trip count
+        return (max(consts.values()), False) if consts else (1, False)
+    distinct = set(consts.values())
+    if len(distinct) == 1:
+        return next(iter(distinct)), True
+    return (max(distinct), False) if distinct else (1, False)
 
 
 def _group_size(line: str):
@@ -134,6 +203,26 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
     """
     comps = _split_computations(compiled_text)
 
+    def _comp_group_size(comp_name: str):
+        """Participant count for a collective-fusion kernel, read off the
+        first replica_groups inside its called computation."""
+        for ln in comps.get(comp_name, []):
+            if "replica_groups=" in ln:
+                return _group_size(ln)
+        return None
+
+    def _done_half_results(lines: List[str]) -> set:
+        """Result names consumed by a ROOT custom-call — the completion
+        marker of an async collective fusion (see note at _FUSION_CALL_RE).
+        A collective whose result feeds that ROOT is the done half."""
+        for ln in lines:
+            s = ln.strip()
+            if s.startswith("ROOT") and " custom-call(" in s:
+                args = s.split(" custom-call(", 1)[1].rsplit(")", 1)[0]
+                return {a.strip().split()[-1].lstrip("%")
+                        for a in args.split(",") if a.strip()}
+        return set()
+
     # per-computation: local collectives and calls to other computations
     local: Dict[str, List[Tuple[str, int, int]]] = {}
     edges: Dict[str, List[Tuple[str, int, str]]] = {}
@@ -142,20 +231,46 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
     for name, lines in comps.items():
         local[name] = []
         edges[name] = []
+        if name.startswith(_RS_FUSION_PREFIX):
+            # payload of a TPU ring reduce-scatter kernel: its inner
+            # all-reduce is an implementation detail of the fused kernel,
+            # accounted by the CALLING fusion line's classification
+            continue
+        done_results = _done_half_results(lines)
         for ln in lines:
+            fm = _FUSION_CALL_RE.search(ln)
+            if fm and fm.group(1).startswith(_RS_FUSION_PREFIX) \
+                    and " fusion(" in ln and "=" in ln.split(" fusion(")[0]:
+                # TPU ring reduce-scatter kernel: payload = fusion output
+                seg = ln.split(" fusion(")[0].split("=", 1)[1]
+                n = _comp_group_size(fm.group(1))
+                if n is None:
+                    unresolved_groups.append(ln.strip()[:160])
+                    n = 1
+                local[name].append(
+                    ("reduce-scatter", _shape_bytes(seg), n)
+                )
+                continue  # deliberately NOT walked into (see _FUSION_CALL_RE)
             for op in _COLLECTIVES:
                 # plain op: "= <shapes> op(...)"; async pair: count the
                 # -done (its result is the final payload), skip the -start
                 token = f" {op}("
                 done = f" {op}-done("
+                # the -start exclusion matches the OP TOKEN only: TPU HLO
+                # tags async-scheduled plain ops with frontend_attributes=
+                # {async_collective_name="all-gather-start.N"}, and a
+                # substring test would skip those real ops entirely
                 if done in ln:
                     seg = ln.split(done)[0]
-                elif token in ln and f"{op}-start" not in ln:
+                elif token in ln and f" {op}-start(" not in ln:
                     seg = ln.split(token)[0]
                 else:
                     continue
                 if "=" not in seg:
                     continue
+                result_name = seg.strip().split()[0].lstrip("%")
+                if result_name in done_results:
+                    break  # done half of an async pair, not a transfer
                 seg = seg.split("=", 1)[1]
                 n = _group_size(ln)
                 if n is None:
@@ -175,6 +290,8 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
             cm = _CALL_RE.search(ln)
             if cm and cm.group(1) in comps:
                 edges[name].append((cm.group(1), 1, "call"))
+            if fm and fm.group(1) in comps:
+                edges[name].append((fm.group(1), 1, "fusion"))
             bm = _BRANCH_RE.search(ln)
             if bm:
                 for b in re.findall(r"%?([\w\.\-]+)", bm.group(1)):

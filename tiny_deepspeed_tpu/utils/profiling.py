@@ -134,10 +134,21 @@ def comm_report(engine) -> Dict[str, float]:
             for name, s in shapes.items() if not name.startswith("h.")
         )
 
+    # Round 4, measured on the v5e:4x2 compile-only topology (PROFILE.md
+    # "TPU topology HLO"): the replicated-grad all-reduce rides in COMPUTE
+    # dtype — XLA commutes the reduction with the grad's f32 cast — so
+    # DDP/ZeRO-1 reduction payloads are cd-priced (halves the bf16 bill vs
+    # the old f32-grad pricing; exact on f32-compute models).  The sharded
+    # -grad reduce-scatter of ZeRO-2/3 stays in PARAM dtype: the constraint
+    # lands on the post-cast f32 grads and the partitioner keeps it.
+    g_cd = sum(
+        int(np.prod(s.shape)) * cd_itemsize for s in shapes.values()
+    )
     report = {
         "devices": n,
         "param_bytes": g,
-        "grad_allreduce_bytes": 2 * g * ring if stage <= 1 and n > 1 else 0.0,
+        "grad_allreduce_bytes": 2 * g_cd * ring if stage <= 1 and n > 1
+        else 0.0,
         "grad_reduce_scatter_bytes": g * ring if stage >= 2 else 0.0,
         "grad_reduce_scatter_is_upper_bounded_by_allreduce": stage >= 2,
         "param_all_gather_bytes": g * ring if stage in (1, 2) else 0.0,
