@@ -51,31 +51,31 @@ LAST_GOOD = os.path.join(
 )
 
 
-def _default_config() -> bool:
-    """ONE predicate for both the save and load sites: the cache holds only
-    the canonical default invocation — no batch/seq/model overrides, no
-    autotune (round-3 advice: a tuned-program run must not overwrite the
-    default-config record), no decode/offload modes."""
-    return (not os.environ.get("BENCH_BATCH")
-            and not os.environ.get("BENCH_OFFLOAD")
-            and not os.environ.get("BENCH_AUTOTUNE")
-            and not os.environ.get("BENCH_DECODE")
-            and not os.environ.get("BENCH_MODEL")
-            and int(os.environ.get("BENCH_SEQ", "1024")) == 1024)
-
-
-def _config_fingerprint() -> str:
+def _config_fingerprint(env=None) -> str:
     """Canonical string of every knob that changes what bench.py measures;
     stored in the last-good record and matched at replay so a cache written
     under one config can never be reported as a measurement of another."""
+    env = os.environ if env is None else env
     return json.dumps({
-        "model": os.environ.get("BENCH_MODEL", "gpt2-124m"),
-        "batch": os.environ.get("BENCH_BATCH", ""),
-        "seq": os.environ.get("BENCH_SEQ", "1024"),
-        "offload": os.environ.get("BENCH_OFFLOAD", ""),
-        "autotune": os.environ.get("BENCH_AUTOTUNE", ""),
-        "decode": os.environ.get("BENCH_DECODE", ""),
+        "model": env.get("BENCH_MODEL", "gpt2-124m"),
+        "batch": env.get("BENCH_BATCH", ""),
+        "seq": env.get("BENCH_SEQ", "1024"),
+        "offload": env.get("BENCH_OFFLOAD", ""),
+        "autotune": env.get("BENCH_AUTOTUNE", ""),
+        "decode": env.get("BENCH_DECODE", ""),
     }, sort_keys=True)
+
+
+# the all-defaults fingerprint: same knob list, every env var absent
+_DEFAULT_FINGERPRINT = _config_fingerprint(env={})
+
+
+def _default_config() -> bool:
+    """ONE predicate for both the save and load sites: the cache holds only
+    the canonical default invocation (round-3 advice: a tuned-program run
+    must not overwrite the default-config record).  Derived from the
+    fingerprint so there is a single knob list to maintain."""
+    return _config_fingerprint() == _DEFAULT_FINGERPRINT
 
 
 def _git_head() -> str:

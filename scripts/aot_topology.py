@@ -102,11 +102,15 @@ def _batch_structs(engine, b, t):
     return (s, s)
 
 
-def analyze(engine, b, t, label):
+def analyze(engine, b, t, label, dump_dir=None):
     state = _state_structs(engine)
     batch = _batch_structs(engine, b, t)
     compiled = engine._step.lower(state, batch).compile()
     text = compiled.as_text()
+    if dump_dir:
+        os.makedirs(dump_dir, exist_ok=True)
+        with open(os.path.join(dump_dir, f"{label}.hlo"), "w") as f:
+            f.write(text)
     ledger = collective_ledger(text)
     starts = {}
     for m in _COLLECTIVE_START_RE.finditer(text):
@@ -142,6 +146,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--topology", default="v5e:4x2")
     ap.add_argument("--json", default="/tmp/aot_topology.json")
+    ap.add_argument("--dump-hlo", default=None, metavar="DIR",
+                    help="also write each config's compiled HLO text to "
+                         "DIR/<label>.hlo (the PROFILE.md evidence files)")
     args = ap.parse_args()
 
     topo = topologies.get_topology_desc(platform="tpu",
@@ -182,7 +189,7 @@ def main():
     for label, make in cases:
         try:
             engine = make()
-            res = analyze(engine, b, t, label)
+            res = analyze(engine, b, t, label, dump_dir=args.dump_hlo)
             rs = res["ledger"]["wire_bytes"].get("reduce-scatter", 0)
             ar = res["ledger"]["wire_bytes"].get("all-reduce", 0)
             print(f"{label}: total_wire={res['ledger']['total_wire_bytes']:.3e}"

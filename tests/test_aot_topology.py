@@ -87,12 +87,13 @@ class TestTpuTopologyHLO:
         text = _compiled_text(eng)
         led = collective_ledger(text)
         assert not led["unresolved_loops"], led["unresolved_loops"]
-        # per-layer gathers present and loop-multiplied; remat-bwd
-        # re-gathers put the measured bytes ABOVE the 2x-block model but
-        # below 2x of it (PROFILE.md finding 5 pins the window)
+        # per-layer gathers match the 2x-block + 1x-nonblock model to a
+        # few percent (measured +0.04% — PROFILE.md finding 4): the remat
+        # backward re-gathers each block weight exactly once, and the
+        # ledger's async-copy channel dedup reads the TPU dialect right
         predicted = comm_report(eng)["zero3_layer_gather_bytes"]
         ag = led["wire_bytes"].get("all-gather", 0)
-        assert predicted <= ag <= 2.0 * predicted, (ag, predicted)
+        assert 0.95 * predicted <= ag <= 1.05 * predicted, (ag, predicted)
         # the gathers are issued as async start fusions (overlap evidence)
         assert "%async-collective-start" in text or \
             "async_collective_name" in text

@@ -153,6 +153,41 @@ class TestCommReportVsCompiledHLO:
         trips, resolved = _trip_count(dynamic)
         assert not resolved
 
+        # ROOT compare takes precedence over stray compares BOTH ways:
+        # a resolved ROOT bound ignores a constant side-compare, and a
+        # dynamic ROOT bound is NOT resolved by one
+        stray = [
+            "  %c4 = s32[] constant(4)",
+            "  %c99 = s32[] constant(99)",
+            "  %flagcmp = pred[] compare(%x, %c99), direction=LT",
+            "  ROOT %cmp = pred[] compare(%iv, %c4), direction=LT",
+        ]
+        assert _trip_count(stray) == (4, True)
+        stray_dyn = [
+            "  %c99 = s32[] constant(99)",
+            "  %flagcmp = pred[] compare(%x, %c99), direction=LT",
+            "  ROOT %cmp = pred[] compare(%iv, %bound), direction=LT",
+        ]
+        trips, resolved = _trip_count(stray_dyn)
+        assert not resolved
+
+        # compound condition: the compare feeds a ROOT `and` — the bound
+        # constant must still resolve via the non-ROOT compare, and a
+        # dynamic-bound variant must stay unresolved despite the clamp
+        compound = [
+            "  %c4 = s32[] constant(4)",
+            "  %cmp = pred[] compare(%iv, %c4), direction=LT",
+            "  ROOT %and = pred[] and(%cmp, %flag)",
+        ]
+        assert _trip_count(compound) == (4, True)
+        compound_dyn = [
+            "  %c99 = s32[] constant(99)",
+            "  %cmp = pred[] compare(%iv, %bound), direction=LT",
+            "  ROOT %and = pred[] and(%cmp, %flag)",
+        ]
+        trips, resolved = _trip_count(compound_dyn)
+        assert not resolved
+
         # no ROOT compare found at all: agreeing constants still resolve
         agreeing = [
             "  %c8 = s32[] constant(8)",

@@ -360,6 +360,20 @@ class ZeroEngine:
         self.rank_map = partition_tensors(
             shapes, self.n_shard, evenness_priority
         )
+        if evenness_priority:
+            # the knob is real for the TABLE but deliberately inert for the
+            # layout: engines always shard evenly along tensor axes (SPMD)
+            # rather than placing whole tensors per owner like the
+            # reference; say so instead of silently ignoring the intent
+            import warnings
+            warnings.warn(
+                "evenness_priority shapes only engine.rank_map (the "
+                "reference-parity ownership report); the physical layout "
+                "is always even axis-sharding.  For the reference's "
+                "whole-tensor placement semantics use partition_tensors + "
+                "materialize_owned directly (parallel/partition.py).",
+                stacklevel=2,
+            )
 
         # tensor/expert-parallel placements come from the model and are part
         # of EVERY spec (resting, shard, grad, optimizer) — ZeRO's data-axis

@@ -66,14 +66,18 @@ _CALL_RE = re.compile(r"to_apply=%?([\w\.\-]+)")
 # would price the ring at 2x its real wire bytes.
 _FUSION_CALL_RE = re.compile(r"\bcalls=%?([\w\.\-]+)")
 _RS_FUSION_PREFIX = "all-reduce-scatter"
-# async halves: the TPU scheduler splits one logical collective into a
-# start fusion (kCustom, the op overlapped with neighboring compute — its
-# ROOT is a tuple carrying the in-flight buffers) and a done fusion whose
-# ROOT is a custom-call consuming the same printed collective op.  Only the
-# start half is a wire transfer; the done half is a completion marker and
-# must not double the ledger.  (channel_id alone cannot dedup: XLA reuses
-# a channel across legitimate clones of one logical op, e.g. peeled loop
-# iterations.)
+# Async copies: the TPU scheduler prints ONE logical collective in SEVERAL
+# fusion payload computations — an AsyncCollectiveStart-rooted wrapper, one
+# "in flight during this kernel" copy per compute fusion it overlaps (up to
+# 5 observed), and an AsyncCollectiveDone-rooted completion — all with the
+# SAME channel_id and result shape, all called from the SAME computation.
+# Within fusion payloads sharing a caller, the channel is therefore the
+# identity of the transfer and is counted once.  The dedup is scoped to
+# (channel, caller): a peeled clone whose fusion payload is called from a
+# DIFFERENT computation is a second real transfer and keeps its count.
+# Plain computations (entry, while bodies, shard_map bodies) are exempt
+# entirely — there a repeated channel is always a legitimate clone.
+_CHANNEL_RE = re.compile(r"\bchannel_id=(\d+)\b")
 _BRANCH_RE = re.compile(r"branch_computations=\{([^}]*)\}")
 _TRUE_FALSE_RE = re.compile(
     r"(?:true_computation|false_computation)=%?([\w\.\-]+)"
@@ -128,45 +132,61 @@ _COMPARE_ARGS_RE = re.compile(
 def _trip_count(cond_lines: List[str]) -> Tuple[int, bool]:
     """(static trip count, resolved?) of a while loop, from its condition
     computation: the bound is the integer constant the induction variable
-    compares against in the ROOT compare.  Resolution order (round-3
-    advice: "max constant anywhere" silently inflated the multiplier when
-    the condition carried an unrelated larger constant, e.g. a clamp
-    bound):
-      1. a constant that is an operand of the ROOT compare;
-      2. otherwise, a condition whose constants all agree is unambiguous;
-      3. otherwise (0 constants, or several distinct non-operand ones):
-         (max-or-1, False) — the caller flags it in `unresolved_loops`
-         so tests catch the ambiguity instead of trusting the total."""
+    compares against.  Resolution order (round-3 advice: "max constant
+    anywhere" silently inflated the multiplier when the condition carried
+    an unrelated larger constant, e.g. a clamp bound):
+      1. compare ops (ROOT or not — the compare may feed a ROOT and/or)
+         whose operands resolve to exactly ONE distinct constant;
+      2. a condition with NO compare at all but agreeing constants;
+      3. otherwise: (max-or-1, False) — the caller flags it in
+         `unresolved_loops` so tests catch the ambiguity instead of
+         trusting the total."""
     consts: Dict[str, int] = {}
     for ln in cond_lines:
         for m in _CONST_DEF_RE.finditer(ln):
             consts[m.group(1)] = int(m.group(2))
-    root_compare_seen = False
+
+    def _const_operands(line: str):
+        cm = _COMPARE_ARGS_RE.search(line)
+        if not cm:
+            return None  # not a compare
+        args = cm.group(1) if cm.group(1) is not None else cm.group(2)
+        # layout braces ("{1,0:T(8,128)}") contain commas; strip first
+        args = re.sub(r"\{[^}]*\}", "", args)
+        vals = set()
+        for arg in args.split(","):
+            arg = arg.strip()
+            if arg and arg.split()[-1].lstrip("%") in consts:
+                vals.add(consts[arg.split()[-1].lstrip("%")])
+        return vals
+
+    # rule 1: the ROOT compare is authoritative when present — a stray
+    # compare elsewhere (a clamp, a flag test) must neither override a
+    # resolved ROOT bound nor resolve a dynamic one
     for ln in cond_lines:
         s = ln.strip()
         if not s.startswith("ROOT"):
             continue
-        cm = _COMPARE_ARGS_RE.search(s)
-        if not cm:
+        vals = _const_operands(s)
+        if vals is None:
             continue
-        root_compare_seen = True
-        args = cm.group(1) if cm.group(1) is not None else cm.group(2)
-        # layout braces ("{1,0:T(8,128)}") contain commas; strip before split
-        args = re.sub(r"\{[^}]*\}", "", args)
-        operand_vals = []
-        for arg in args.split(","):
-            arg = arg.strip()
-            if not arg:
-                continue
-            name = arg.split()[-1].lstrip("%")
-            if name in consts:
-                operand_vals.append(consts[name])
-        if len(operand_vals) == 1:
-            return operand_vals[0], True
-    if root_compare_seen:
-        # the bound is dynamic (no constant operand): any constant in the
-        # condition is unrelated — never promote it to a trip count
+        if len(vals) == 1:
+            return next(iter(vals)), True
         return (max(consts.values()), False) if consts else (1, False)
+    # rule 2: no ROOT compare (e.g. the compare feeds a ROOT `and`) —
+    # resolve iff every compare in the condition agrees on ONE constant
+    all_vals, compare_seen = set(), False
+    for ln in cond_lines:
+        vals = _const_operands(ln)
+        if vals is None:
+            continue
+        compare_seen = True
+        all_vals |= vals
+    if len(all_vals) == 1:
+        return next(iter(all_vals)), True
+    if compare_seen:
+        return (max(consts.values()), False) if consts else (1, False)
+    # rule 3: no compares at all — agreeing constants are unambiguous
     distinct = set(consts.values())
     if len(distinct) == 1:
         return next(iter(distinct)), True
@@ -211,23 +231,21 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                 return _group_size(ln)
         return None
 
-    def _done_half_results(lines: List[str]) -> set:
-        """Result names consumed by a ROOT custom-call — the completion
-        marker of an async collective fusion (see note at _FUSION_CALL_RE).
-        A collective whose result feeds that ROOT is the done half."""
+    # fusion payload computation -> the computation that calls it (see the
+    # channel-dedup note above; payloads have a single fusion call site)
+    fusion_caller: Dict[str, str] = {}
+    for caller, lines in comps.items():
         for ln in lines:
-            s = ln.strip()
-            if s.startswith("ROOT") and " custom-call(" in s:
-                args = s.split(" custom-call(", 1)[1].rsplit(")", 1)[0]
-                return {a.strip().split()[-1].lstrip("%")
-                        for a in args.split(",") if a.strip()}
-        return set()
+            m = _FUSION_CALL_RE.search(ln)
+            if m:
+                fusion_caller.setdefault(m.group(1), caller)
 
     # per-computation: local collectives and calls to other computations
     local: Dict[str, List[Tuple[str, int, int]]] = {}
     edges: Dict[str, List[Tuple[str, int, str]]] = {}
     unresolved: List[str] = []
     unresolved_groups: List[str] = []
+    seen_channels: set = set()
     for name, lines in comps.items():
         local[name] = []
         edges[name] = []
@@ -236,7 +254,7 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
             # all-reduce is an implementation detail of the fused kernel,
             # accounted by the CALLING fusion line's classification
             continue
-        done_results = _done_half_results(lines)
+        dedup_scope = fusion_caller.get(name)
         for ln in lines:
             fm = _FUSION_CALL_RE.search(ln)
             if fm and fm.group(1).startswith(_RS_FUSION_PREFIX) \
@@ -268,9 +286,13 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                     continue
                 if "=" not in seg:
                     continue
-                result_name = seg.strip().split()[0].lstrip("%")
-                if result_name in done_results:
-                    break  # done half of an async pair, not a transfer
+                if dedup_scope is not None:
+                    chm = _CHANNEL_RE.search(ln)
+                    if chm is not None:
+                        key = (chm.group(1), dedup_scope)
+                        if key in seen_channels:
+                            break  # async copy of a counted transfer
+                        seen_channels.add(key)
                 seg = seg.split("=", 1)[1]
                 n = _group_size(ln)
                 if n is None:
