@@ -88,10 +88,19 @@ def _state_structs(engine):
         dropout_base = jax.ShapeDtypeStruct(
             key.shape, key.dtype, sharding=engine._dropout_shardings
         )
+    scaler = None
+    if engine._scaler_shardings is not None:  # loss_scale="dynamic"
+        scaler = {
+            "scale": jax.ShapeDtypeStruct(
+                (), jnp.float32,
+                sharding=engine._scaler_shardings["scale"]),
+            "good": jax.ShapeDtypeStruct(
+                (), jnp.int32, sharding=engine._scaler_shardings["good"]),
+        }
     return TrainState(
         params=attach(params, engine._param_shardings),
         opt_state=attach(opt, engine._opt_shardings),
-        scaler=None,
+        scaler=scaler,
         dropout_base=dropout_base,
     )
 

@@ -241,12 +241,20 @@ class ZeroEngine:
         offload_opt_state: ZeRO-Offload-style placement — optimizer
         moments REST in host memory (NamedSharding memory_kind
         "pinned_host") instead of HBM, freeing ~8 bytes/param of chip
-        memory between steps (f32 moments); the step streams them through
-        the device for the update.  The scalar step counter stays in
+        memory between steps (f32 moments); the update STREAMS them
+        through HBM one parameter leaf at a time (_offload_update:
+        explicit transfer in -> update_one -> transfer out, barrier-
+        chained so XLA cannot bulk-hoist the transfers — round-4 AOT
+        topology measurement on gpt2-1.5b: compiled peak 12.8 GB streamed
+        vs 17.0 GB bulk vs 15.2 GB unoffloaded; resting device state
+        9.2 -> 3.1 GB).  Streaming granularity is one stacked leaf — the
+        h.* tensors carry all L layers, so the largest in-flight chunk is
+        one weight's (L, ...) moments.  The scalar step counter stays in
         device memory (its side-effecting placement annotation trips the
         SPMD partitioner).  TPU-runtime feature: XLA CPU does not
-        implement the placement custom-call, so this knob is exercised by
-        TPU-gated tests only (tests/test_offload.py)."""
+        implement the placement custom-call, so execution is covered by
+        TPU-gated tests (tests/test_offload.py) and compilation by the
+        TPU-topology AOT tests (tests/test_aot_topology.py)."""
         self.model = model
         self.optimizer = optimizer
         pp = int(pipeline_parallel)
@@ -451,6 +459,16 @@ class ZeroEngine:
         self._opt_shardings = _to_shardings(opt_specs, mesh)
         self.offload_opt_state = bool(offload_opt_state)
         if self.offload_opt_state:
+            from ..optim.base import Optimizer as _OptBase
+            if type(optimizer).update is not _OptBase.update:
+                # the streamed update path calls update_one per leaf; an
+                # optimizer overriding update() (cross-parameter logic)
+                # would be silently bypassed — refuse instead
+                raise ValueError(
+                    f"offload_opt_state streams moments via the per-leaf "
+                    f"update_one contract, but {type(optimizer).__name__} "
+                    f"overrides update(); offload is unsupported for it"
+                )
             if jax.default_backend() != "tpu":
                 import warnings
                 warnings.warn(
@@ -460,7 +478,12 @@ class ZeroEngine:
                     stacklevel=2,
                 )
             # per-param moments to host memory; "step" (and any other
-            # top-level scalar) stays device-resident
+            # top-level scalar) stays device-resident.  The step streams
+            # them through HBM for the update (_step_impl transfers in;
+            # out_shardings put the new moments back) — TPU XLA refuses
+            # mixed-memory-space arithmetic, so the transfer must be
+            # explicit (caught by the round-4 AOT topology compile).
+            self._opt_dev_shardings = self._opt_shardings["state"]
             self._opt_shardings = dict(
                 self._opt_shardings,
                 state=jax.tree.map(
@@ -605,6 +628,55 @@ class ZeroEngine:
             jax.lax.with_sharding_constraint, tree, shardings
         )
 
+    def _offload_update(self, params, grads, opt_state, finite=None):
+        """Optimizer update for `offload_opt_state`: moments REST in
+        pinned_host and are STREAMED through HBM leaf by leaf — transfer
+        in, update_one, transfer back — double-buffered: leaf i's inbound
+        transfer is made data-dependent (optimization_barrier) on leaf
+        i-2's outbound copy, so at most two leaves' moments are in HBM
+        while transfer and update compute can still overlap.  Without any
+        chaining XLA hoists every transfer to the front and the full
+        moments sit in HBM as one temp allocation, erasing the feature's
+        point (measured on the round-4 AOT topology compile: 1.5B peak
+        17.0 GB unchained vs 12.8 GB double-buffered vs 15.2 GB
+        unoffloaded).
+        `finite` (dynamic loss scaling) applies the keep-old MOMENTS
+        selection ON DEVICE before the copy-out — host-space arithmetic is
+        rejected by the TPU compiler; the params selection stays with the
+        caller's _sel like the non-offload path.  Mirrors
+        Optimizer.update's step/state contract via the public update_one
+        hook; optimizers overriding update() are rejected at engine
+        construction."""
+        step_new = opt_state["step"] + 1
+        new_params, new_state = {}, {}
+        tokens = [(), ()]
+        for n, p in params.items():
+            host_leaf = opt_state["state"][n]
+            host_leaf, _ = jax.lax.optimization_barrier(
+                (host_leaf, tokens[-2])
+            )
+            dev_leaf = jax.tree.map(
+                jax.device_put, host_leaf, self._opt_dev_shardings[n]
+            )
+            np_, ns = self.optimizer.update_one(
+                n, p, grads[n], dev_leaf, step_new
+            )
+            if finite is not None:
+                ns = jax.tree.map(
+                    lambda a, b: jnp.where(finite, a, b.astype(a.dtype)),
+                    ns, dev_leaf,
+                )
+            ns_host = jax.tree.map(
+                jax.device_put, ns, self._opt_shardings["state"][n]
+            )
+            new_params[n], new_state[n] = np_, ns_host
+            tokens.append(tuple(jax.tree.leaves(ns_host)))
+        step_out = (
+            jnp.where(finite, step_new, opt_state["step"])
+            if finite is not None else step_new
+        )
+        return new_params, {"step": step_out, "state": new_state}
+
     def _step_impl(self, state: "TrainState", batch):
         idx, targets = batch
         params = state.params
@@ -712,9 +784,15 @@ class ZeroEngine:
             # replicated-param grads becomes a reduce-scatter.
             grads = self._constrain(grads, self._shard_shardings)
 
-        new_params, new_opt = self.optimizer.update(
-            params, grads, state.opt_state
-        )
+        if self.offload_opt_state:
+            new_params, new_opt = self._offload_update(
+                params, grads, state.opt_state,
+                finite if dynamic else None,
+            )
+        else:
+            new_params, new_opt = self.optimizer.update(
+                params, grads, state.opt_state
+            )
         new_scaler = state.scaler
         if dynamic:
             # overflow -> discard the whole update (params, moments, AND the
@@ -726,7 +804,10 @@ class ZeroEngine:
                     new, old,
                 )
             new_params = _sel(new_params, params)
-            new_opt = _sel(new_opt, state.opt_state)
+            if not self.offload_opt_state:
+                # offloaded moments already selected on device inside
+                # _offload_update (host-space where() won't compile on TPU)
+                new_opt = _sel(new_opt, state.opt_state)
             good = state.scaler["good"] + 1
             grow = good >= self.loss_scale_growth_interval
             new_scaler = {
