@@ -82,6 +82,47 @@ class TestTpuTopologyHLO:
         assert abs(led["total_wire_bytes"] - predicted) <= 0.05 * predicted, \
             (led["total_wire_bytes"], predicted)
 
+    def test_multislice_hybrid_mesh_and_compile(self):
+        """make_mesh's hybrid ICI x DCN layout, exercised on REAL
+        multi-slice TPU devices (2-slice v5e:2x2 topology, compile-only):
+        the 'data' axis must span the slices (DCN — gradient reductions
+        amortize), every other axis must stay inside one slice (ICI — its
+        collectives sit on the critical path), and the tensor-parallel
+        train step must compile against that mesh.  Until round 4 this
+        layout was only tested against mocked slice_index devices
+        (tests/test_mesh.py)."""
+        from jax.experimental import topologies
+        from tiny_deepspeed_tpu import Zero1, make_mesh
+
+        try:
+            topo = topologies.get_topology_desc(
+                platform="tpu", topology_name="v5e:2x2", num_slices=2
+            )
+        except Exception as e:
+            pytest.skip(f"multi-slice TPU topology unavailable: {e}")
+        devices = list(topo.devices)
+        assert len(devices) == 8
+        assert {d.slice_index for d in devices} == {0, 1}
+
+        mesh = make_mesh((2, 4), ("data", "model"), devices=devices)
+        grid = mesh.devices  # (data=2, model=4)
+        # model-axis rows: one slice each (ICI); data-axis pairs: both
+        # slices (DCN)
+        for row in grid:
+            assert len({d.slice_index for d in row}) == 1, grid
+        for col in grid.T:
+            assert {d.slice_index for d in col} == {0, 1}, grid
+
+        cfg = GPTConfig(block_size=128, vocab_size=512, n_layer=2,
+                        n_head=4, n_embd=256)
+        # the mesh's "model" axis drives tensor parallelism (an explicit
+        # mesh bypasses the engine's own axis carving)
+        eng = Zero1(GPT2Model(cfg), AdamW(lr=1e-3), mesh=mesh)
+        text = _compiled_text(eng, b=4, t=128)
+        led = collective_ledger(text)
+        assert led["total_wire_bytes"] > 0
+        assert not led["unresolved_loops"], led["unresolved_loops"]
+
     def test_offload_streamed_update_compiles_on_tpu(self, topo_mesh):
         """offload_opt_state AOT-compiles against the real TPU topology —
         the round-4 compile caught that host-resident moments were being
