@@ -106,7 +106,10 @@ def _state_structs(engine):
 
 
 def _batch_structs(engine, b, t):
-    s = jax.ShapeDtypeStruct((b, t), jnp.int32,
+    shape = (b, t)
+    if engine.accum_steps > 1:  # microbatched step: (accum, B, T)
+        shape = (engine.accum_steps,) + shape
+    s = jax.ShapeDtypeStruct(shape, jnp.int32,
                              sharding=engine._batch_sharding)
     return (s, s)
 
@@ -176,6 +179,13 @@ def main():
     mesh_tp = Mesh(devs.reshape(n // 2, 2), ("data", "model"))
     mesh_sp = Mesh(devs.reshape(n // 2, 2), ("data", "seq"))
     mesh_pp = Mesh(devs.reshape(n // 2, 2), ("data", "pipe"))
+    mesh_ep = Mesh(devs.reshape(n // 2, 2), ("data", "expert"))
+
+    def _moe_ep_engine():
+        from tiny_deepspeed_tpu import MoEConfig, MoEGPT
+        mcfg = MoEConfig(block_size=128, vocab_size=512, n_layer=2,
+                         n_head=4, n_embd=64, n_expert=4, expert_top_k=2)
+        return Zero2(MoEGPT(mcfg), opt(), mesh=mesh_ep, expert_parallel=2)
 
     cases = [
         ("ddp", lambda: DDP(GPT2Model(cfg), opt(), mesh=mesh_dp)),
@@ -192,6 +202,13 @@ def main():
         ("zero1-pipe2-1f1b", lambda: Zero1(
             GPT2Model(cfg), opt(), mesh=mesh_pp, pipeline_parallel=2,
             pipeline_microbatches=4, pipeline_schedule="1f1b")),
+        # sharded f32 accumulator across microbatches: each microbatch's
+        # grads reduce-scatter into the shard (engine.py round-1 design)
+        ("zero2-accum4", lambda: Zero2(GPT2Model(cfg), opt(),
+                                       mesh=mesh_dp, accum_steps=4)),
+        # expert parallelism: capacity-bucketed dispatch over the "expert"
+        # axis — does the TPU partitioner emit real all-to-all?
+        ("moe-zero2-ep2", lambda: _moe_ep_engine()),
     ]
 
     results = []

@@ -43,6 +43,13 @@ class TestCommReport:
         rep0 = comm_report(DDP(model, AdamW(lr=1e-3)))
         rep2 = comm_report(Zero2(model, AdamW(lr=1e-3)))
         rep3 = comm_report(Zero3(model, AdamW(lr=1e-3)))
+        # stage >= 2 accumulation reduce-scatters PER microbatch (TPU
+        # topology measurement, PROFILE.md); stage <= 1 still syncs once
+        rep2a = comm_report(Zero2(model, AdamW(lr=1e-3), accum_steps=4))
+        assert rep2a["grad_reduce_scatter_bytes"] == \
+            4 * rep2["grad_reduce_scatter_bytes"]
+        rep0a = comm_report(DDP(model, AdamW(lr=1e-3), accum_steps=4))
+        assert rep0a["grad_allreduce_bytes"] == rep0["grad_allreduce_bytes"]
         assert rep0["grad_allreduce_bytes"] > 0
         assert rep0["grad_reduce_scatter_bytes"] == 0
         assert rep2["grad_reduce_scatter_bytes"] > 0

@@ -714,10 +714,16 @@ class ZeroEngine:
         if self.accum_steps == 1:
             loss, grads = loss_and_grads(params, idx, targets, rng)
         else:
-            # Microbatch accumulation: batch is (accum, B, T); grads summed
-            # locally across microbatches, collective cost paid once — the
+            # Microbatch accumulation: batch is (accum, B, T) — the
             # reference's `require_backward_grad_sync` gating
-            # (ddp/wrapper.py:25-33) as explicit loop semantics.
+            # (ddp/wrapper.py:25-33) as explicit loop semantics.  Stage
+            # <= 1 (replicated grads): summed locally, ONE all-reduce at
+            # the end.  Stage >= 2 trades that for memory: the constraint
+            # below keeps the f32 accumulator SHARDED, so every microbatch
+            # reduce-scatters into the shard — accum_steps x the wire
+            # bytes (TPU-measured, PROFILE.md) but never a full-size
+            # accumulator per device, which is the point in the big-model
+            # tight-HBM case accumulation exists for.
             def body(carry, mb):
                 acc_loss, acc_grads = carry
                 ix, tg, mb_i = mb

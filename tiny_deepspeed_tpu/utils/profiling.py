@@ -144,12 +144,19 @@ def comm_report(engine) -> Dict[str, float]:
     g_cd = sum(
         int(np.prod(s.shape)) * cd_itemsize for s in shapes.values()
     )
+    # Microbatch accumulation: stage <= 1 keeps grads replicated and truly
+    # pays ONE all-reduce after the local sum; stage >= 2 constrains the
+    # f32 accumulator SHARDED, so every microbatch reduce-scatters into
+    # the shard — accum_steps x the wire bytes (TPU topology measurement,
+    # PROFILE.md zero2-accum4 row: 4x the single-step reduce-scatter).
+    n_sync = int(getattr(engine, "accum_steps", 1)) if stage >= 2 else 1
     report = {
         "devices": n,
         "param_bytes": g,
         "grad_allreduce_bytes": 2 * g_cd * ring if stage <= 1 and n > 1
         else 0.0,
-        "grad_reduce_scatter_bytes": g * ring if stage >= 2 else 0.0,
+        "grad_reduce_scatter_bytes": n_sync * g * ring if stage >= 2
+        else 0.0,
         "grad_reduce_scatter_is_upper_bounded_by_allreduce": stage >= 2,
         "param_all_gather_bytes": g * ring if stage in (1, 2) else 0.0,
         # ZeRO-3: block params gathered per layer in fwd AND in the remat
