@@ -1,4 +1,7 @@
 #!/bin/bash
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 # The round-3 TPU measurement batch (VERDICT items 1-3, 7-8): run the
 # moment the tunnel answers, most-important first, each step tolerant of
 # the tunnel dying again mid-batch.  Everything tees into $OUT.

@@ -1,4 +1,7 @@
 #!/bin/bash
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
 # Probe the TPU tunnel until it answers; exit 0 on success.
 # The axon tunnel hangs (not errors) for hours at a time, so each probe runs
 # jax.devices() in a killable subprocess via `timeout`.

@@ -34,6 +34,20 @@ def test_offload_shardings_host_kind():
     assert kinds == {"pinned_host"}
 
 
+def test_offload_rejects_update_override():
+    """The streamed update path (engine._offload_update) relies on the
+    per-leaf update_one contract; an optimizer overriding update() for
+    cross-parameter logic would be silently bypassed — the engine refuses
+    at construction instead."""
+    class TrustRatioAdamW(AdamW):
+        def update(self, params, grads, opt_state):  # pragma: no cover
+            return super().update(params, grads, opt_state)
+
+    with pytest.raises(ValueError, match="update_one"):
+        SingleDevice(GPT2Model(TINY), TrustRatioAdamW(lr=1e-3),
+                     offload_opt_state=True)
+
+
 def test_offload_execution_on_tpu():
     """One real offloaded step: moments host-resident, loss finite, params
     change.  Skips off-TPU (placement custom-call unimplemented on CPU)."""
