@@ -300,6 +300,13 @@ def _bench_config(model_name: str):
             overrides=dict(param_dtype=jnp.bfloat16, fused_xent=True),
             state_dtype=jnp.bfloat16,
         ),
+        # ~1.2B params: same squeeze as gpt2-1.5b (f32 state = 17.9 GB
+        # compiled, over the 16 GB chip — round-4 AOT measurement)
+        "llama-1b": dict(
+            batch=4,
+            overrides=dict(param_dtype=jnp.bfloat16, fused_xent=True),
+            state_dtype=jnp.bfloat16,
+        ),
     }
     return table.get(model_name,
                      dict(batch=8, overrides={}, state_dtype=None))

@@ -50,6 +50,7 @@ from tiny_deepspeed_tpu import (
     AdamW, DDP, GPT2Model, GPTConfig, Zero1, Zero2, Zero3,
 )
 from tiny_deepspeed_tpu.parallel.engine import TrainState
+from tiny_deepspeed_tpu.ops.dispatch import kernel_target_forced
 from tiny_deepspeed_tpu.utils.hlo_comm import collective_ledger
 from tiny_deepspeed_tpu.utils.profiling import comm_report
 
@@ -117,7 +118,12 @@ def _batch_structs(engine, b, t):
 def analyze(engine, b, t, label, dump_dir=None):
     state = _state_structs(engine)
     batch = _batch_structs(engine, b, t)
-    compiled = engine._step.lower(state, batch).compile()
+    # trace with the TPU kernel gates ON: the process backend is CPU, but
+    # the program targets TPU — without the force every Pallas gate picks
+    # the XLA fallback and the compiled program differs from the chip's
+    # (ops/dispatch.py; found in round 4 via chip-vs-AOT memory mismatch)
+    with kernel_target_forced("tpu"):
+        compiled = engine._step.lower(state, batch).compile()
     text = compiled.as_text()
     if dump_dir:
         os.makedirs(dump_dir, exist_ok=True)

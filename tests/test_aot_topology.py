@@ -24,6 +24,7 @@ import numpy as np
 import pytest
 
 from tiny_deepspeed_tpu import AdamW, GPT2Model, GPTConfig, Zero2, Zero3
+from tiny_deepspeed_tpu.ops.dispatch import kernel_target_forced
 from tiny_deepspeed_tpu.utils.hlo_comm import collective_ledger
 from tiny_deepspeed_tpu.utils.profiling import comm_report
 
@@ -60,7 +61,10 @@ CFG = GPTConfig(block_size=128, vocab_size=512, n_layer=4, n_head=8,
 def _compiled_text(engine, b=8, t=128):
     state = _aot._state_structs(engine)
     batch = _aot._batch_structs(engine, b, t)
-    return engine._step.lower(state, batch).compile().as_text()
+    # trace with the TPU kernel gates ON (ops/dispatch.py): the process
+    # backend is CPU but the program targets the topology's TPUs
+    with kernel_target_forced("tpu"):
+        return engine._step.lower(state, batch).compile().as_text()
 
 
 class TestTpuTopologyHLO:
@@ -150,9 +154,10 @@ class TestTpuTopologyHLO:
 
         def peak(engine):
             state = _aot._state_structs(engine)
-            compiled = engine._step.lower(
-                state, _aot._batch_structs(engine, 4, 128)
-            ).compile()
+            with kernel_target_forced("tpu"):
+                compiled = engine._step.lower(
+                    state, _aot._batch_structs(engine, 4, 128)
+                ).compile()
             hbm_state = sum(
                 int(np.prod(x.shape)) * x.dtype.itemsize
                 for x in jax.tree.leaves(state)
@@ -176,9 +181,10 @@ class TestTpuTopologyHLO:
 
         # dynamic loss scaling composes (selection happens on device)
         dyn = build(offload_opt_state=True, loss_scale="dynamic")
-        dyn._step.lower(
-            _aot._state_structs(dyn), _aot._batch_structs(dyn, 4, 128)
-        ).compile()
+        with kernel_target_forced("tpu"):
+            dyn._step.lower(
+                _aot._state_structs(dyn), _aot._batch_structs(dyn, 4, 128)
+            ).compile()
 
     def test_zero3_layer_gathers_async_and_counted(self, topo_mesh):
         eng = Zero3(GPT2Model(CFG), AdamW(lr=1e-3), mesh=topo_mesh)

@@ -28,6 +28,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from .dispatch import kernel_target
+
 
 def standard_attention(q, k, v):
     """Causal softmax(QK^T/sqrt(d))V with an explicit mask (reference :29-42)."""
@@ -74,7 +76,7 @@ def flash_attention(q, k, v):
     """Blockwise causal attention; Pallas kernel on TPU, fused XLA elsewhere."""
     # Static (trace-time) backend choice: tracers carry no device, and the
     # kernel choice must be baked into the jitted program anyway.
-    if jax.default_backend() == "tpu":
+    if kernel_target() == "tpu":
         return _tuned_pallas_flash(q, k, v)
     return _sdpa_or_standard(q, k, v)
 
@@ -158,7 +160,7 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
             )
         return local_fn(q, k, v)
 
-    if impl == "flash_attention" and jax.default_backend() == "tpu":
+    if impl == "flash_attention" and kernel_target() == "tpu":
         spec = P(pctx.data_axis, head_axis, None, None)
         return jax.shard_map(
             _tuned_pallas_flash, mesh=pctx.mesh,

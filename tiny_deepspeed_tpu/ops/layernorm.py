@@ -30,13 +30,22 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .dispatch import in_gspmd_auto_region, kernel_target
+
 
 def _pallas_ok(x) -> bool:
     """Pallas layernorm kernels are candidates on TPU (or anywhere in
-    interpret mode — how the CPU CI mesh exercises them)."""
+    interpret mode — how the CPU CI mesh exercises them, exempt from the
+    region check below because interpret-mode kernels lower to plain XLA
+    ops GSPMD can partition) — but the real Mosaic kernel is never picked
+    inside a GSPMD auto-partitioned multi-device region, where the custom
+    call cannot be partitioned and lowering fails (dispatch.py)."""
     from .layernorm_pallas import INTERPRET, pallas_supported
-    return (jax.default_backend() == "tpu" or INTERPRET) and \
-        pallas_supported(x)
+    if INTERPRET:
+        return pallas_supported(x)
+    if in_gspmd_auto_region():
+        return False
+    return kernel_target() == "tpu" and pallas_supported(x)
 
 
 def _fwd_candidates(x):

@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..ops.dispatch import kernel_target
+
 from .base import Optimizer
 
 
@@ -95,14 +97,20 @@ class AdamW(Optimizer):
             )
             return False
         # multi-device ALWAYS refuses (even fused=True): the custom call
-        # cannot be GSPMD-partitioned, so sharded state would all-gather
-        if jax.device_count() != 1:
+        # cannot be GSPMD-partitioned, so sharded state would all-gather.
+        # Two signals, either sufficient: the engine's trace-time region
+        # marker (accurate for AOT-for-topology compiles, where the
+        # PROCESS has one CPU device but the PROGRAM spans a multi-chip
+        # mesh — ops/dispatch.py) and the process device count (covers
+        # optimizer use outside any engine).
+        from ..ops.dispatch import in_gspmd_auto_region
+        if in_gspmd_auto_region() or jax.device_count() != 1:
             self._warn_unfused("multi-device (custom call is not "
                                "GSPMD-partitionable)")
             return False
         # the kernel only lowers via Mosaic (TPU) or interpret mode; other
         # backends fall back to XLA for both "auto" and True
-        ok = jax.default_backend() == "tpu" or INTERPRET
+        ok = kernel_target() == "tpu" or INTERPRET
         if not ok:
             self._warn_unfused(f"backend {jax.default_backend()!r} cannot "
                                "lower the Mosaic kernel")

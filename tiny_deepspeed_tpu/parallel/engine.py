@@ -510,12 +510,16 @@ class ZeroEngine:
         self._batch_sharding = NamedSharding(mesh, batch_spec)
 
         self._build_step()
+
+        def _eval_impl(params, ix, tg):
+            from ..ops.dispatch import gspmd_auto_region
+            with gspmd_auto_region(self.n_dev > 1):
+                return self.model.apply(params, ix, tg, pctx=self.pctx)
+
         # forward-only loss (validation): no dropout (no rng), no grads, no
         # state change; always takes a plain (B, T) batch (no accum axis)
         self._eval = jax.jit(
-            lambda params, ix, tg: self.model.apply(
-                params, ix, tg, pctx=self.pctx
-            ),
+            _eval_impl,
             in_shardings=(
                 self._param_shardings,
                 self._eval_batch_sharding, self._eval_batch_sharding,
@@ -678,6 +682,15 @@ class ZeroEngine:
         return new_params, {"step": step_out, "state": new_state}
 
     def _step_impl(self, state: "TrainState", batch):
+        # trace-time marker: on a multi-device mesh this program is GSPMD
+        # auto-partitioned, so naked Mosaic custom calls cannot lower —
+        # the layernorm gate reads this and keeps the XLA path
+        # (ops/dispatch.py; attention wraps its own shard_map instead)
+        from ..ops.dispatch import gspmd_auto_region
+        with gspmd_auto_region(self.n_dev > 1):
+            return self._step_body(state, batch)
+
+    def _step_body(self, state: "TrainState", batch):
         idx, targets = batch
         params = state.params
         dynamic = self.loss_scale == "dynamic"

@@ -36,6 +36,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..ops.dispatch import kernel_target
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -111,7 +113,7 @@ def spmd_pipeline(
     # all-reduces inside manual regions ("Invalid binary instruction opcode
     # copy").  On TPU the native dtype goes through (half the HBM/ICI bytes).
     boundary_dtype = (
-        jnp.float32 if jax.default_backend() == "cpu" else dtype
+        jnp.float32 if kernel_target() == "cpu" else dtype
     )
     sp = _active_axis(mesh, seq_axis)
     xmb = x.reshape(m, b // m, *x.shape[1:]).astype(boundary_dtype)

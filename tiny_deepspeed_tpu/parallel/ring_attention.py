@@ -68,8 +68,16 @@ def ring_attention_local(q, k, v, *, axis_name: str, axis_size: int):
         vc = jax.lax.ppermute(vc, axis_name, perm)
         return (o, l, m_new, kc, vc), None
 
+    # remat the BODY: differentiating a scan stashes each step's residuals,
+    # and this body's are the (Tl, Tl) score/probability matrices — at
+    # T=32k/ring=8 that is axis_size x (B, H, 4096, 4096) f32, a ~26 GB
+    # stack that defeats the O(T/n) memory claim (first seen on the
+    # round-4 TPU-topology compile).  checkpoint saves only the step
+    # inputs (the rotating K/V carries, O(n * Tl * d) total) and recomputes
+    # scores in the backward — the standard ring-attention backward, which
+    # re-runs the ring's ppermutes for the recompute.
     (o, l, _, _, _), _ = jax.lax.scan(
-        step, (o0, l0, m0, k, v), jnp.arange(axis_size)
+        jax.checkpoint(step), (o0, l0, m0, k, v), jnp.arange(axis_size)
     )
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
