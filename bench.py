@@ -270,15 +270,22 @@ def _bench_config(model_name: str):
         # bf16 resting params beat f32 across the matrix (measured r2:
         # 124m 88.3k vs 86.8k, 350m 32.0k vs 31.7k, 774m 16.1k vs 15.4k):
         # the per-step f32->bf16 cast of every weight disappears and weight
-        # HBM traffic halves.  AdamW moments stay f32 (except 774m/1.5b
-        # where bf16 moments are what makes the model fit); update math is
-        # f32 either way.  124m batch 10 not 12: b12 is ~1% faster but sits
-        # at the compile-memory edge (b16 fails); 13.9 GB leaves headroom
-        # for an unattended run.
-        "gpt2-124m": dict(batch=10,
+        # HBM traffic halves.  AdamW moments: bf16 wherever measured
+        # faster or needed to fit (124m/774m/1.5b/moe/llama-1b), f32 on
+        # 350m; update math is f32 either way.
+        # 124m (round-4 live-chip grid, /tmp/mfu_sweep):
+        # b12 + bf16 moments = 92.3k tok/s / 0.401 matmul MFU vs b10+f32
+        # 90.0k / 0.392 — bf16 moments halve the optimizer-state HBM
+        # traffic that dominates the small model's update.  fused_xent
+        # LOSES at this size (b12: 86.6k, b10: 84.5k) — the full-logits
+        # matmul rides the MXU better than the chunked head; it's a
+        # memory knob, needed only from 774m up.  b13/b14 regress
+        # (90.3k/89.6k).  A compile OOM, if the envelope moves again,
+        # steps down b12->b11 (91.8k) via the guard below.
+        "gpt2-124m": dict(batch=12,
                           overrides=dict(remat=False,
                                          param_dtype=jnp.bfloat16),
-                          state_dtype=jnp.float32),
+                          state_dtype=jnp.bfloat16),
         "gpt2-350m": dict(batch=8,
                           overrides=dict(param_dtype=jnp.bfloat16),
                           state_dtype=jnp.float32),
