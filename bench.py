@@ -282,16 +282,27 @@ def _bench_config(model_name: str):
         # memory knob, needed only from 774m up.  b13/b14 regress
         # (90.3k/89.6k).  A compile OOM, if the envelope moves again,
         # steps down b12->b11 (91.8k) via the guard below.
+        # scan_unroll=True wherever it measured faster (round-4 chip runs):
+        # it deletes the layer-scan's activation-stash slice traffic (the
+        # 124m profile priced it at ~16 ms of a 132 ms step) — 124m 92.0k
+        # -> 106.5k (+16%), 350m 32.5k -> 33.9k, 774m 15.4k -> 17.1k,
+        # llama-160m 94.1k -> 105.4k.  1.5b stays SCANNED: it remats with
+        # policy "nothing" (no stash to delete) and unroll=4/8 measured
+        # 7.5k/6.9k vs 8.0k scanned; full unroll fails to compile at 48
+        # layers (remote_compile 500).
         "gpt2-124m": dict(batch=12,
                           overrides=dict(remat=False,
-                                         param_dtype=jnp.bfloat16),
+                                         param_dtype=jnp.bfloat16,
+                                         scan_unroll=True),
                           state_dtype=jnp.bfloat16),
         "gpt2-350m": dict(batch=8,
-                          overrides=dict(param_dtype=jnp.bfloat16),
+                          overrides=dict(param_dtype=jnp.bfloat16,
+                                         scan_unroll=True),
                           state_dtype=jnp.float32),
         "gpt2-774m": dict(batch=4,
                           overrides=dict(param_dtype=jnp.bfloat16,
-                                         fused_xent=True),
+                                         fused_xent=True,
+                                         scan_unroll=True),
                           state_dtype=jnp.bfloat16),
         "gpt2-1.5b": dict(
             batch=4,
@@ -304,7 +315,17 @@ def _bench_config(model_name: str):
         # the bound, not activations
         "moe-8x124m": dict(
             batch=4,
-            overrides=dict(param_dtype=jnp.bfloat16, fused_xent=True),
+            overrides=dict(param_dtype=jnp.bfloat16, fused_xent=True,
+                           scan_unroll=True),
+            state_dtype=jnp.bfloat16,
+        ),
+        # round-4 live-chip grid (/tmp/llama_sweep): bf16 params + bf16
+        # moments + remat OFF at b=12 = 94.1k tok/s / 0.381 matmul MFU vs
+        # the old untuned f32 defaults 89.4k / 0.362; b=16 regresses
+        "llama-160m": dict(
+            batch=12,
+            overrides=dict(param_dtype=jnp.bfloat16, remat=False,
+                           scan_unroll=True),
             state_dtype=jnp.bfloat16,
         ),
         # ~1.2B params: same squeeze as gpt2-1.5b (f32 state = 17.9 GB

@@ -233,3 +233,16 @@ class TestEngines:
 
     def test_describe(self, model):
         assert "stage=2" in Zero2(model, AdamW(lr=1e-3)).describe()
+
+    def test_zero3_warns_on_scan_unroll(self):
+        """scan_unroll under ZeRO-3 defeats the per-layer gather memory
+        bound (the scan is what keeps one layer's weights live) — the
+        engine must say so; other stages must stay silent."""
+        import warnings as _w
+        m = GPT2Model(dataclasses.replace(TINY, scan_unroll=True))
+        with pytest.warns(UserWarning, match="scan_unroll"):
+            Zero3(m, AdamW(lr=1e-3))
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            Zero2(m, AdamW(lr=1e-3))          # no warning below stage 3
+            Zero3(GPT2Model(TINY), AdamW(lr=1e-3))  # scanned: no warning

@@ -64,6 +64,24 @@ class TestGPT2:
             rtol=1e-4, atol=1e-4,
         )
 
+    def test_scan_unroll_matches_scanned(self):
+        """scan_unroll is a pure scheduling knob: fully unrolling the layer
+        scan must not change the forward loss or any gradient (same math,
+        same order — only the stacked-stash addressing changes)."""
+        import dataclasses
+        m_scan = GPT2Model(TINY)
+        m_unroll = GPT2Model(dataclasses.replace(TINY, scan_unroll=True))
+        params = m_scan.init(jax.random.PRNGKey(0))
+        idx = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0, 128)
+        l1, g1 = jax.value_and_grad(lambda p: m_scan.apply(p, idx, tgt))(params)
+        l2, g2 = jax.value_and_grad(lambda p: m_unroll.apply(p, idx, tgt))(params)
+        assert np.allclose(float(l1), float(l2), rtol=1e-6)
+        for k in g1:
+            np.testing.assert_allclose(
+                np.asarray(g1[k], np.float32), np.asarray(g2[k], np.float32),
+                rtol=2e-5, atol=2e-6, err_msg=k)
+
     def test_block_size_enforced(self):
         model = GPT2Model(TINY)
         params = model.init(jax.random.PRNGKey(0))

@@ -324,7 +324,8 @@ class MoEGPT(GPT2Model):
                 block = jax.checkpoint(block, policy=self.remat_policy())
 
             (x, aux_sum), _ = jax.lax.scan(
-                block, (x, jnp.zeros((), jnp.float32)), stacked
+                block, (x, jnp.zeros((), jnp.float32)), stacked,
+                unroll=c.scan_unroll,
             )
 
         out = self.head(params, x, targets, pctx, position)

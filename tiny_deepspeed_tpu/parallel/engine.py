@@ -60,6 +60,7 @@ under XLA it would force both program paths into every step."""
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from functools import partial
 from typing import Any, Dict, Optional
 
@@ -258,6 +259,17 @@ class ZeroEngine:
         self.model = model
         self.optimizer = optimizer
         pp = int(pipeline_parallel)
+        _unroll = getattr(getattr(model, "config", None), "scan_unroll", 1)
+        if self.stage == 3 and (_unroll is True or _unroll not in (1, False)):
+            # the documented footgun (GPTConfig.scan_unroll): ZeRO-3's
+            # per-layer gather memory bound RELIES on the scan — an
+            # unrolled stack lets XLA hoist the gathers and regrow
+            # full-model HBM
+            warnings.warn(
+                "scan_unroll != 1 under ZeRO-3 defeats the per-layer "
+                "all-gather memory bound (XLA may hoist every layer's "
+                "gather); use the scanned stack (scan_unroll=1) for "
+                "ZeRO-3 runs", stacklevel=2)
         if mesh is None:
             if not self.data_parallel:
                 mesh = make_mesh(devices=[jax.devices()[0]])
@@ -373,7 +385,6 @@ class ZeroEngine:
             # layout: engines always shard evenly along tensor axes (SPMD)
             # rather than placing whole tensors per owner like the
             # reference; say so instead of silently ignoring the intent
-            import warnings
             warnings.warn(
                 "evenness_priority shapes only engine.rank_map (the "
                 "reference-parity ownership report); the physical layout "
@@ -470,7 +481,6 @@ class ZeroEngine:
                     f"overrides update(); offload is unsupported for it"
                 )
             if jax.default_backend() != "tpu":
-                import warnings
                 warnings.warn(
                     "offload_opt_state needs the TPU runtime — XLA CPU "
                     "has no placement custom-call; expect "
