@@ -1,0 +1,100 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Parity tests for the hand-written FA2 kernel (ops/flash_fa2.py).
+
+Runs in Pallas `interpret=True` mode on the CPU mesh (no Mosaic backend
+there); the real-chip numbers are in BASELINE.md.  Reference semantics:
+softmax(QK^T/sqrt(d)) with a causal mask, i.e. exactly
+`ops.attention.standard_attention`."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tiny_deepspeed_tpu.ops import flash_fa2
+from tiny_deepspeed_tpu.ops.attention import standard_attention
+from tiny_deepspeed_tpu.ops.flash_fa2 import fa2_flash_attention
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    old = flash_fa2._INTERPRET
+    flash_fa2._INTERPRET = True
+    yield
+    flash_fa2._INTERPRET = old
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+class TestFA2:
+    def test_forward_matches_standard(self):
+        q, k, v = (_rand((2, 3, 256, 64), i) for i in range(3))
+        np.testing.assert_allclose(
+            np.asarray(fa2_flash_attention(q, k, v, 128, 128)),
+            np.asarray(standard_attention(q, k, v)), rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_standard(self):
+        q, k, v = (_rand((1, 2, 256, 64), i) for i in range(3))
+        g1 = jax.grad(lambda *a: jnp.sum(fa2_flash_attention(*a, 128, 128) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(standard_attention(*a) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5,
+                err_msg=f"d{name}")
+
+    def test_uneven_blocks(self):
+        """block_q != block_k exercises the diagonal-straddling masks."""
+        q, k, v = (_rand((1, 1, 512, 64), i) for i in range(3))
+        np.testing.assert_allclose(
+            np.asarray(fa2_flash_attention(q, k, v, 256, 128)),
+            np.asarray(standard_attention(q, k, v)), rtol=2e-5, atol=2e-5)
+
+    def test_small_t_single_block(self):
+        """T smaller than any block: _pick degrades to one full block."""
+        q, k, v = (_rand((2, 2, 64, 64), i) for i in range(3))
+        np.testing.assert_allclose(
+            np.asarray(fa2_flash_attention(q, k, v, 512, 512)),
+            np.asarray(standard_attention(q, k, v)), rtol=2e-5, atol=2e-5)
+
+    def test_bf16_inputs(self):
+        q, k, v = (_rand((1, 2, 256, 64), i, jnp.bfloat16) for i in range(3))
+        o = fa2_flash_attention(q, k, v, 128, 128)
+        assert o.dtype == jnp.bfloat16
+        ref = standard_attention(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), np.asarray(ref, np.float32),
+            rtol=0.05, atol=0.02)
+
+    def test_composes_with_remat(self):
+        """jax.checkpoint over the kernel (the block remat path)."""
+        q, k, v = (_rand((1, 1, 128, 64), i) for i in range(3))
+        f = jax.checkpoint(
+            lambda q, k, v: jnp.sum(fa2_flash_attention(q, k, v, 128, 128)))
+        g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        ref = jax.grad(
+            lambda q, k, v: jnp.sum(standard_attention(q, k, v)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-5)
+
+    def test_lse_residual_shape(self):
+        """The whole point: the stashed stat is ONE (B*H, 1, T) f32 tensor."""
+        q, k, v = (_rand((2, 3, 256, 64), i) for i in range(3))
+        out, (res_q, res_k, res_v, o, lse) = flash_fa2._fa2_fwd(
+            q, k, v, 128, 128)
+        assert lse.shape == (2 * 3, 1, 256)
+        assert lse.dtype == jnp.float32
+        # lse really is logsumexp of the masked scores
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(64)
+        mask = jnp.tril(jnp.ones((256, 256), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+        ref = jax.nn.logsumexp(s, axis=-1).reshape(6, 1, 256)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)

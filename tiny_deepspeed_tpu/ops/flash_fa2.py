@@ -1,0 +1,312 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Hand-written FA2-style causal flash attention for TPU (Pallas).
+
+Why another kernel when `ops/attention_pallas.py` already wraps JAX's
+bundled one: the round-4 chip profile (PROFILE.md "chip profile") showed
+the bundled kernel's XLA-side residual plumbing materializing ~9 ms/step
+of f32 broadcasts on gpt2-124m — it stashes softmax stats as separate
+running-max `m` and running-sum `l`, each expanded to `[B, H, T, 128]`
+(its MIN_BLOCK_SIZE), and its backward additionally expands the
+`di = rowsum(do*o)` contraction the same way.  This kernel is the
+FlashAttention-2 formulation (Dao, arXiv:2307.08691) built TPU-first:
+
+  * ONE fused stat: the forward emits `lse = m + log(l)` of shape
+    (B*H, T) — 128x fewer residual bytes than m+l at [.,128] each; the
+    backward consumes it directly (`p = exp(s - lse)`), no rescaling
+    pass, no broadcast materialization in HBM.
+  * K/V (and in the backward, Q/dO) ride VMEM whole per (batch, head):
+    at GPT-2 shapes a (T, 64) bf16 panel is 128 KB, so the inner
+    k-block loop is VMEM-resident with zero HBM refetch; the grid walks
+    only (B*H, T/block).  Causality is exact loop bounds (`fori_loop` to
+    the diagonal), not masked wasted blocks — plus one iota mask on the
+    diagonal block itself.
+  * dq and dkv stay two separate passes (dq is row-parallel, dkv is
+    column-parallel; TPU has no cross-program atomics to fuse them), the
+    same decomposition as the bundled kernel — the win is the stat diet
+    and the VMEM residency, not the pass count.
+
+Numerics: all matmuls accumulate f32 on the MXU
+(`preferred_element_type`), softmax/statistics math is f32, outputs cast
+back to the input dtype.  Parity vs the bundled kernel and vs plain
+softmax(QK^T)V autodiff is pinned in tests/test_flash_fa2.py (CPU
+`interpret=True` and the real chip).
+
+The reference has no kernel of its own at this layer — its
+"flash_attention" calls torch's F.scaled_dot_product_attention
+(reference example/model.py:44-51); this file is the TPU-native
+counterpart of what that call delegates to cuDNN.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _causal_mask(s, iq, jk, bq, bk):
+    """Mask (bq, bk) scores for q-block iq vs k-block jk (additive)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                *, scale, bq, bk):
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (bq, d)
+
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # k-blocks [0, nfull) lie entirely below the diagonal (no mask);
+    # [nfull, ndiag) straddle it (iota mask); ndiag is one past the last
+    # block any row of this q-block may see.
+    nfull = iq * bq // bk
+    ndiag = pl.cdiv((iq + 1) * bq, bk)
+
+    def step(jk, m, l, masked):
+        k = k_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if masked:
+            s = _causal_mask(s, iq, jk, bq, bk)
+        m_cur = jnp.maximum(m, jnp.max(s, axis=1))
+        alpha = jnp.exp(m - m_cur)                      # (bq,)
+        p = jnp.exp(s - m_cur[:, None])                 # (bq, bk)
+        l = l * alpha + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_cur, l
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    m, l = jax.lax.fori_loop(
+        0, nfull, lambda jk, c: step(jk, *c, masked=False), (m0, l0))
+    m, l = jax.lax.fori_loop(
+        nfull, ndiag, lambda jk, c: step(jk, *c, masked=True), (m, l))
+
+    o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _fwd(q, k, v, *, scale, bq, bk):
+    bh, t, d = q.shape
+    grid = (bh, t // bq)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),    # acc
+        ],
+        interpret=_INTERPRET,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, bq, bk):
+    jk = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)   # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+
+    dk_acc[:] = jnp.zeros_like(dk_acc)
+    dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    nq = pl.num_programs(1) * bk // bq  # q-blocks total (t // bq)
+    first = jk * bk // bq               # first q-block touching this k-block
+    idiag_end = pl.cdiv((jk + 1) * bk, bq)  # first FULLY-unmasked q-block
+
+    def body(iq, masked):
+        q = q_ref[0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(iq * bq, bq)]
+        di = di_ref[0, 0, pl.ds(iq * bq, bq)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if masked:
+            s = _causal_mask(s, iq, jk, bq, bk)
+        p = jnp.exp(s - lse[:, None])                    # (bq, bk)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        ds = p * (dp - di[:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return 0
+
+    jax.lax.fori_loop(first, idiag_end,
+                      lambda i, c: body(i, masked=True), 0)
+    jax.lax.fori_loop(idiag_end, nq,
+                      lambda i, c: body(i, masked=False), 0)
+    dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                   dq_ref, dq_acc, *, scale, bq, bk):
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    di = di_ref[0, 0]
+
+    dq_acc[:] = jnp.zeros_like(dq_acc)
+    nfull = iq * bq // bk
+    ndiag = pl.cdiv((iq + 1) * bq, bk)
+
+    def body(jk, masked):
+        k = k_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if masked:
+            s = _causal_mask(s, iq, jk, bq, bk)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - di[:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return 0
+
+    jax.lax.fori_loop(0, nfull, lambda j, c: body(j, masked=False), 0)
+    jax.lax.fori_loop(nfull, ndiag, lambda j, c: body(j, masked=True), 0)
+    dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd(res, g, *, scale, bq, bk):
+    q, k, v, o, lse = res
+    bh, t, d = q.shape
+    do = g
+    # di = rowsum(do * o): one fused elementwise+reduce in XLA, (bh, t) f32
+    # — consumed directly by both kernels, never broadcast to block width
+    di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                 axis=-1)[:, None, :]
+
+    kv_specs = [
+        pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),      # q (full)
+        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),     # k (block)
+        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),     # v (block)
+        pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),      # do (full)
+        pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),      # lse (full)
+        pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),      # di (full)
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk),
+        grid=(bh, t // bk),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(q, k, v, do, lse, di)
+
+    q_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),     # q (block)
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),      # k (full)
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),      # v (full)
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),     # do (block)
+        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),     # lse (block)
+        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),     # di (block)
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk),
+        grid=(bh, t // bq),
+        in_specs=q_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_INTERPRET,
+    )(q, k, v, do, lse, di)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (custom_vjp over (B, H, T, Dh))
+# ---------------------------------------------------------------------------
+
+_INTERPRET = False  # tests flip this on CPU (no Mosaic backend there)
+
+
+from .attention_pallas import _pick_block as _pick  # shared block picker
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fa2_flash_attention(q, k, v, block_q: int = 512, block_k: int = 512):
+    """Causal FA2 attention on (B, H, T, Dh); returns (B, H, T, Dh)."""
+    out, _ = _fa2_fwd(q, k, v, block_q, block_k)
+    return out
+
+
+def _fa2_fwd(q, k, v, block_q, block_k):
+    b, h, t, d = q.shape
+    bq, bk = _pick(t, block_q), _pick(t, block_k)
+    scale = 1.0 / math.sqrt(d)
+    flat = lambda x: x.reshape(b * h, t, d)
+    o, lse = _fwd(flat(q), flat(k), flat(v), scale=scale, bq=bq, bk=bk)
+    return o.reshape(b, h, t, d), (q, k, v, o.reshape(b, h, t, d), lse)
+
+
+def _fa2_bwd(block_q, block_k, res, g):
+    q, k, v, o, lse = res
+    b, h, t, d = q.shape
+    bq, bk = _pick(t, block_q), _pick(t, block_k)
+    scale = 1.0 / math.sqrt(d)
+    flat = lambda x: x.reshape(b * h, t, d)
+    dq, dk, dv = _bwd(
+        (flat(q), flat(k), flat(v), flat(o), lse), flat(g),
+        scale=scale, bq=bq, bk=bk)
+    unflat = lambda x: x.reshape(b, h, t, d)
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
+fa2_flash_attention.defvjp(_fa2_fwd, _fa2_bwd)
