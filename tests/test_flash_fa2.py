@@ -84,6 +84,28 @@ class TestFA2:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-5, atol=5e-5)
 
+    def test_tuner_variant_guards_long_t(self, monkeypatch):
+        """FLASH_VARIANTS must be T-safe at ANY length: the tuner's
+        candidates[0]/frozen fallbacks dispatch without timing, so the FA2
+        entries fall back to the blocked bundled kernel past FA2_MAX_T
+        instead of compiling FA2's full VMEM panels."""
+        from tiny_deepspeed_tpu.ops import attention_pallas as ap
+
+        calls = []
+        monkeypatch.setattr(
+            ap, "pallas_flash_attention",
+            lambda q, k, v, **kw: calls.append("bundled") or q)
+        monkeypatch.setattr(
+            flash_fa2, "fa2_flash_attention",
+            lambda q, k, v, *a: calls.append("fa2") or q)
+        fa2_variant = next(f for f in ap.FLASH_VARIANTS
+                           if f.__name__.startswith("fa2"))
+        long_t = jnp.zeros((1, 1, ap.FA2_MAX_T + 1024, 64), jnp.bfloat16)
+        short_t = jnp.zeros((1, 1, 256, 64), jnp.bfloat16)
+        fa2_variant(long_t, long_t, long_t)
+        fa2_variant(short_t, short_t, short_t)
+        assert calls == ["bundled", "fa2"]
+
     def test_lse_residual_shape(self):
         """The whole point: the stashed stat is ONE (B*H, 1, T) f32 tensor."""
         q, k, v = (_rand((2, 3, 256, 64), i) for i in range(3))
