@@ -49,6 +49,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .attention_pallas import _pick_block as _pick  # shared block picker
+
 NEG_INF = -1e30
 
 
@@ -277,9 +279,6 @@ def _bwd(res, g, *, scale, bq, bk):
 _INTERPRET = False  # tests flip this on CPU (no Mosaic backend there)
 
 
-from .attention_pallas import _pick_block as _pick  # shared block picker
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def fa2_flash_attention(q, k, v, block_q: int = 512, block_k: int = 512):
     """Causal FA2 attention on (B, H, T, Dh); returns (B, H, T, Dh)."""
@@ -293,7 +292,8 @@ def _fa2_fwd(q, k, v, block_q, block_k):
     scale = 1.0 / math.sqrt(d)
     flat = lambda x: x.reshape(b * h, t, d)
     o, lse = _fwd(flat(q), flat(k), flat(v), scale=scale, bq=bq, bk=bk)
-    return o.reshape(b, h, t, d), (q, k, v, o.reshape(b, h, t, d), lse)
+    o = o.reshape(b, h, t, d)
+    return o, (q, k, v, o, lse)
 
 
 def _fa2_bwd(block_q, block_k, res, g):
