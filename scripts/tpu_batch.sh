@@ -67,4 +67,8 @@ log "9. offload bench (1.5b HBM delta)"
 timeout 2400 env BENCH_OFFLOAD=1 BENCH_MODEL=gpt2-1.5b python bench.py > "$OUT/bench_offload.json" 2> "$OUT/bench_offload.err"
 log "   rc=$? $(cat "$OUT/bench_offload.json" 2>/dev/null | head -c 200)"
 
+log "10. heads-last FA2 A/B (round-4 experiment, see scripts/fa2_bthd_ab.py)"
+timeout 1200 python scripts/fa2_bthd_ab.py > "$OUT/fa2_bthd_ab.jsonl" 2> "$OUT/fa2_bthd_ab.err"
+log "   rc=$? $(cat "$OUT/fa2_bthd_ab.jsonl" 2>/dev/null | tr '\n' ' ' | head -c 300)"
+
 log "batch complete; results in $OUT"

@@ -106,6 +106,26 @@ class TestFA2:
         fa2_variant(short_t, short_t, short_t)
         assert calls == ["bundled", "fa2"]
 
+    def test_bthd_layout_matches_bhtd(self):
+        """The heads-last entry must be bit-for-bit the standard entry's
+        result transposed — fwd and all three grads (same kernels, only
+        the BlockSpec addressing differs)."""
+        from tiny_deepspeed_tpu.ops.flash_fa2 import fa2_flash_attention_bthd
+        q, k, v = (_rand((2, 2, 256, 64), i) for i in range(3))
+        qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # (B, T, H, Dh)
+        o_std = fa2_flash_attention(q, k, v, 128, 128)
+        o_hl = fa2_flash_attention_bthd(qt, kt, vt, 128, 128)
+        np.testing.assert_array_equal(np.asarray(o_hl.swapaxes(1, 2)),
+                                      np.asarray(o_std))
+        g_std = jax.grad(lambda *a: jnp.sum(fa2_flash_attention(*a, 128, 128) ** 2),
+                         argnums=(0, 1, 2))(q, k, v)
+        g_hl = jax.grad(lambda *a: jnp.sum(fa2_flash_attention_bthd(*a, 128, 128) ** 2),
+                        argnums=(0, 1, 2))(qt, kt, vt)
+        for name, a, b in zip("qkv", g_std, g_hl):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b.swapaxes(1, 2)),
+                rtol=1e-6, atol=1e-7, err_msg=f"d{name}")
+
     def test_lse_residual_shape(self):
         """The whole point: the stashed stat is ONE (B*H, 1, T) f32 tensor."""
         q, k, v = (_rand((2, 3, 256, 64), i) for i in range(3))

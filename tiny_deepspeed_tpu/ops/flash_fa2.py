@@ -65,10 +65,23 @@ def _causal_mask(s, iq, jk, bq, bk):
 # forward
 # ---------------------------------------------------------------------------
 
+def _rd(ref, hl, sl=None):
+    """(X, d) panel from a (1, X, d) ref — or (1, X, 1, d) when heads-last."""
+    sl = slice(None) if sl is None else sl
+    return ref[0, sl, 0, :] if hl else ref[0, sl, :]
+
+
+def _wr(ref, hl, val):
+    if hl:
+        ref[0, :, 0, :] = val
+    else:
+        ref[0] = val
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
-                *, scale, bq, bk):
+                *, scale, bq, bk, hl=False):
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (bq, d)
+    q = _rd(q_ref, hl).astype(jnp.float32)  # (bq, d)
 
     acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -79,8 +92,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
     ndiag = pl.cdiv((iq + 1) * bq, bk)
 
     def step(jk, m, l, masked):
-        k = k_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
+        k = _rd(k_ref, hl, pl.ds(jk * bk, bk)).astype(jnp.float32)
+        v = _rd(v_ref, hl, pl.ds(jk * bk, bk)).astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -102,27 +115,47 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
     m, l = jax.lax.fori_loop(
         nfull, ndiag, lambda jk, c: step(jk, *c, masked=True), (m, l))
 
-    o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+    _wr(o_ref, hl, (acc_ref[:] / l[:, None]).astype(o_ref.dtype))
     lse_ref[0, 0] = m + jnp.log(l)
 
 
-def _fwd(q, k, v, *, scale, bq, bk):
-    bh, t, d = q.shape
-    grid = (bh, t // bq)
+def _specs(*, heads, t, d, size):
+    """BlockSpec for one q/k/v/o/grad panel operand.
+
+    Standard layout: array (bh, t, d), block (1, size, d) at (b, i_or_0, 0).
+    Heads-last: array (B, t, H, d), block (1, size, 1, d) — the head axis
+    is addressed by the index map (no XLA transpose ever materializes).
+    `size` None means the full-T panel (index pinned to 0)."""
+    h = heads
+    if size is None:
+        if h is None:
+            return pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
+        return pl.BlockSpec((1, t, 1, d), lambda b, i: (b // h, 0, b % h, 0))
+    if h is None:
+        return pl.BlockSpec((1, size, d), lambda b, i: (b, i, 0))
+    return pl.BlockSpec((1, size, 1, d), lambda b, i: (b // h, i, b % h, 0))
+
+
+def _fwd(q, k, v, *, scale, bq, bk, heads=None):
+    if heads is None:
+        bh, t, d = q.shape
+        oshape = (bh, t, d)
+    else:
+        b_, t, h_, d = q.shape
+        bh = b_ * h_
+        oshape = (b_, t, h_, d)
+    sp = functools.partial(_specs, heads=heads, t=t, d=d)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-        ],
+        functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk,
+                          hl=heads is not None),
+        grid=(bh, t // bq),
+        in_specs=[sp(size=bq), sp(size=None), sp(size=None)],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            sp(size=bq),
             pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct(oshape, q.dtype),
             jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ],
         scratch_shapes=[
@@ -138,10 +171,11 @@ def _fwd(q, k, v, *, scale, bq, bk):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, bq, bk):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, bq, bk,
+                    hl=False):
     jk = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)   # (bk, d)
-    v = v_ref[0].astype(jnp.float32)
+    k = _rd(k_ref, hl).astype(jnp.float32)   # (bk, d)
+    v = _rd(v_ref, hl).astype(jnp.float32)
 
     dk_acc[:] = jnp.zeros_like(dk_acc)
     dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -151,8 +185,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
     idiag_end = pl.cdiv((jk + 1) * bk, bq)  # first FULLY-unmasked q-block
 
     def body(iq, masked):
-        q = q_ref[0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
+        q = _rd(q_ref, hl, pl.ds(iq * bq, bq)).astype(jnp.float32)
+        do = _rd(do_ref, hl, pl.ds(iq * bq, bq)).astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(iq * bq, bq)]
         di = di_ref[0, 0, pl.ds(iq * bq, bq)]
         s = jax.lax.dot_general(
@@ -177,15 +211,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                       lambda i, c: body(i, masked=True), 0)
     jax.lax.fori_loop(idiag_end, nq,
                       lambda i, c: body(i, masked=False), 0)
-    dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
-    dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+    _wr(dk_ref, hl, dk_acc[:].astype(dk_ref.dtype))
+    _wr(dv_ref, hl, dv_acc[:].astype(dv_ref.dtype))
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
-                   dq_ref, dq_acc, *, scale, bq, bk):
+                   dq_ref, dq_acc, *, scale, bq, bk, hl=False):
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = _rd(q_ref, hl).astype(jnp.float32)
+    do = _rd(do_ref, hl).astype(jnp.float32)
     lse = lse_ref[0, 0]
     di = di_ref[0, 0]
 
@@ -194,8 +228,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
     ndiag = pl.cdiv((iq + 1) * bq, bk)
 
     def body(jk, masked):
-        k = k_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
+        k = _rd(k_ref, hl, pl.ds(jk * bk, bk)).astype(jnp.float32)
+        v = _rd(v_ref, hl, pl.ds(jk * bk, bk)).astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -213,37 +247,46 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     jax.lax.fori_loop(0, nfull, lambda j, c: body(j, masked=False), 0)
     jax.lax.fori_loop(nfull, ndiag, lambda j, c: body(j, masked=True), 0)
-    dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+    _wr(dq_ref, hl, dq_acc[:].astype(dq_ref.dtype))
 
 
-def _bwd(res, g, *, scale, bq, bk):
+def _bwd(res, g, *, scale, bq, bk, heads=None):
     q, k, v, o, lse = res
-    bh, t, d = q.shape
+    if heads is None:
+        bh, t, d = q.shape
+        pshape = (bh, t, d)
+    else:
+        b_, t, h_, d = q.shape
+        bh = b_ * h_
+        pshape = (b_, t, h_, d)
     do = g
-    # di = rowsum(do * o): one fused elementwise+reduce in XLA, (bh, t) f32
-    # — consumed directly by both kernels, never broadcast to block width
-    di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                 axis=-1)[:, None, :]
+    # di = rowsum(do * o): one fused elementwise+reduce in XLA, (bh, 1, t)
+    # f32 — consumed directly by both kernels, never broadcast to block
+    # width.  Heads-last: the (B, t, H) reduce lands as (bh, 1, t) via a
+    # cheap f32 transpose (7 MB at the 124M shape, vs the bf16 panel
+    # transposes this layout exists to delete).
+    di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if heads is None:
+        di = di[:, None, :]
+    else:
+        di = di.transpose(0, 2, 1).reshape(bh, 1, t)
+    sp = functools.partial(_specs, heads=heads, t=t, d=d)
+    hl = heads is not None
 
-    kv_specs = [
-        pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),      # q (full)
-        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),     # k (block)
-        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),     # v (block)
-        pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),      # do (full)
-        pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),      # lse (full)
-        pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),      # di (full)
-    ]
+    stat_full = pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk),
+        functools.partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk, hl=hl),
         grid=(bh, t // bk),
-        in_specs=kv_specs,
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-        ],
+        in_specs=[sp(size=None),   # q (full)
+                  sp(size=bk),     # k (block)
+                  sp(size=bk),     # v (block)
+                  sp(size=None),   # do (full)
+                  stat_full,             # lse (full)
+                  stat_full],            # di (full)
+        out_specs=[sp(size=bk), sp(size=bk)],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+            jax.ShapeDtypeStruct(pshape, k.dtype),
+            jax.ShapeDtypeStruct(pshape, v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -252,20 +295,18 @@ def _bwd(res, g, *, scale, bq, bk):
         interpret=_INTERPRET,
     )(q, k, v, do, lse, di)
 
-    q_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),     # q (block)
-        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),      # k (full)
-        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),      # v (full)
-        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),     # do (block)
-        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),     # lse (block)
-        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),     # di (block)
-    ]
+    stat_blk = pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i))
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk),
+        functools.partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk, hl=hl),
         grid=(bh, t // bq),
-        in_specs=q_specs,
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        in_specs=[sp(size=bq),     # q (block)
+                  sp(size=None),   # k (full)
+                  sp(size=None),   # v (full)
+                  sp(size=bq),     # do (block)
+                  stat_blk,              # lse (block)
+                  stat_blk],             # di (block)
+        out_specs=sp(size=bq),
+        out_shape=jax.ShapeDtypeStruct(pshape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_INTERPRET,
     )(q, k, v, do, lse, di)
@@ -310,3 +351,43 @@ def _fa2_bwd(block_q, block_k, res, g):
 
 
 fa2_flash_attention.defvjp(_fa2_fwd, _fa2_bwd)
+
+
+# ---------------------------------------------------------------------------
+# heads-last entry (B, T, H, Dh) — EXPERIMENTAL, not wired into dispatch
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fa2_flash_attention_bthd(q, k, v, block_q: int = 512,
+                             block_k: int = 512):
+    """Causal FA2 on (B, T, H, Dh) tensors — the layout the QKV matmul
+    produces — addressing the head axis in the kernel's BlockSpec index
+    maps instead of transposing to (B, H, T, Dh) first.  Motivation: the
+    round-4 chip profile priced the per-layer (B,T,H,Dh)->(B,H,T,Dh)
+    copies at ~8.4 ms of the 95 ms gpt2-124m step; this entry would
+    delete them.  Semantics parity with `fa2_flash_attention` is pinned
+    in tests/test_flash_fa2.py (interpret mode); its CHIP timing could
+    not be taken before the round-4 tunnel outage, so it is not the
+    dispatch default — scripts/fa2_bthd_ab.py runs the A/B when the
+    tunnel answers (wired into scripts/tpu_batch.sh)."""
+    out, _ = _fa2_bthd_fwd(q, k, v, block_q, block_k)
+    return out
+
+
+def _fa2_bthd_fwd(q, k, v, block_q, block_k):
+    t, h = q.shape[1], q.shape[2]
+    bq, bk = _pick(t, block_q), _pick(t, block_k)
+    scale = 1.0 / math.sqrt(q.shape[3])
+    o, lse = _fwd(q, k, v, scale=scale, bq=bq, bk=bk, heads=h)
+    return o, (q, k, v, o, lse)
+
+
+def _fa2_bthd_bwd(block_q, block_k, res, g):
+    q = res[0]
+    t, h = q.shape[1], q.shape[2]
+    bq, bk = _pick(t, block_q), _pick(t, block_k)
+    scale = 1.0 / math.sqrt(q.shape[3])
+    return _bwd(res, g, scale=scale, bq=bq, bk=bk, heads=h)
+
+
+fa2_flash_attention_bthd.defvjp(_fa2_bthd_fwd, _fa2_bthd_bwd)
