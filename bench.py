@@ -63,6 +63,7 @@ def _config_fingerprint(env=None) -> str:
         "offload": env.get("BENCH_OFFLOAD", ""),
         "autotune": env.get("BENCH_AUTOTUNE", ""),
         "decode": env.get("BENCH_DECODE", ""),
+        "moe_dispatch": env.get("BENCH_MOE_DISPATCH", ""),
     }, sort_keys=True)
 
 
@@ -350,6 +351,10 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     bc = _bench_config(model_name)
     b = b or bc["batch"]
     cfg = dataclasses.replace(ALL_PRESETS[model_name], **bc["overrides"])
+    md = os.environ.get("BENCH_MOE_DISPATCH")
+    if md and hasattr(cfg, "moe_dispatch"):
+        # round-4 A/B knob: sort vs einsum dispatch (MoEConfig.moe_dispatch)
+        cfg = dataclasses.replace(cfg, moe_dispatch=md)
     if t > cfg.block_size:
         # long-context invocation (BENCH_SEQ=4096/8192): widen the position
         # table and drop the short-context speed knobs — remat back on and

@@ -71,4 +71,8 @@ log "10. heads-last FA2 A/B (round-4 experiment, see scripts/fa2_bthd_ab.py)"
 timeout 1200 python scripts/fa2_bthd_ab.py > "$OUT/fa2_bthd_ab.jsonl" 2> "$OUT/fa2_bthd_ab.err"
 log "   rc=$? $(cat "$OUT/fa2_bthd_ab.jsonl" 2>/dev/null | tr '\n' ' ' | head -c 300)"
 
+log "11. MoE sort-dispatch A/B (round-4 experiment, MoEConfig.moe_dispatch)"
+timeout 1800 env BENCH_MODEL=moe-8x124m BENCH_MOE_DISPATCH=sort python bench.py > "$OUT/bench_moe_sort.json" 2> "$OUT/bench_moe_sort.err"
+log "   rc=$? $(cat "$OUT/bench_moe_sort.json" 2>/dev/null | head -c 200)"
+
 log "batch complete; results in $OUT"

@@ -45,6 +45,19 @@ class MoEConfig(GPTConfig):
     capacity_factor: float = 1.25
     aux_loss_weight: float = 1e-2
     ff_mult: int = 4  # expert hidden = ff_mult * n_embd
+    # dispatch/combine mechanism: "einsum" (GShard-style dense one-hot
+    # matmuls over (S, E, C) — the all-to-all boundary under expert
+    # parallelism) or "sort" (argsort tokens by expert, gather rows into
+    # (E, C, D), scatter-add back).  The einsum pair costs 2*2*S*(E*C)*D
+    # FLOPs per layer — at moe-8x124m bench shape ~2/3 of the expert
+    # matmul FLOPs themselves, none of it counted as model compute — while
+    # the sort path moves the same rows with O(S*k log) sort + gather.
+    # "sort" is single-device/DP only (under EP the einsum contraction IS
+    # what GSPMD turns into the all-to-all; _moe_mlp falls back).  Slot
+    # assignment differs under capacity overflow: einsum fills all 1st
+    # choices before 2nd choices, sort fills token-major — identical
+    # outputs whenever nothing drops (pinned by test).
+    moe_dispatch: str = "einsum"
 
 
 # Entry-point presets (one flat namespace with gpt2-*/llama-*,
@@ -178,14 +191,7 @@ class MoEGPT(GPT2Model):
         e, k = c.n_expert, c.expert_top_k
         cap = capacity or max(1, int(c.capacity_factor * k * s / e))
 
-        logits = jnp.einsum(
-            "sd,de->se", x, router_w, preferred_element_type=jnp.float32
-        )
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        gate_vals, expert_idx = jax.lax.top_k(probs, k)  # (S, k)
-        gate_vals = gate_vals / (
-            jnp.sum(gate_vals, axis=-1, keepdims=True) + 1e-9
-        )
+        gate_vals, expert_idx, aux = self._router(x, router_w)
 
         dispatch = jnp.zeros((s, e, cap), jnp.float32)
         combine = jnp.zeros((s, e, cap), jnp.float32)
@@ -199,12 +205,60 @@ class MoEGPT(GPT2Model):
             combine = combine + gate_vals[:, j, None, None] * slot
             counts = counts + jnp.sum(keep, axis=0)
 
-        # Switch-Transformer load-balancing loss: E * <frac_tokens_e * prob_e>
+        return dispatch, combine, aux
+
+    def _router(self, x, router_w):
+        """Shared router head: (gate_vals (S,k) renormalized, expert_idx
+        (S,k), Switch-Transformer aux scalar E * <frac_tokens_e * prob_e>)."""
+        c = self.config
+        e, k = c.n_expert, c.expert_top_k
+        logits = jnp.einsum(
+            "sd,de->se", x, router_w, preferred_element_type=jnp.float32
+        )
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)  # (S, k)
+        gate_vals = gate_vals / (
+            jnp.sum(gate_vals, axis=-1, keepdims=True) + 1e-9
+        )
         frac = jnp.mean(
             jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32), axis=0
         )
         aux = e * jnp.sum(frac * jnp.mean(probs, axis=0))
-        return dispatch, combine, aux
+        return gate_vals, expert_idx, aux
+
+    def _route_sort(self, x, router_w, capacity=None):
+        """Sort-based dispatch tables (moe_dispatch="sort").
+
+        Returns (src (E*C,) int32 token index per expert slot — S for an
+        empty slot, gate (E*C,) f32 combine weight per slot, aux).  Same
+        router head and capacity formula as `_route`; slots fill
+        token-major (stable argsort by expert), so under overflow the
+        dropped SET can differ from the einsum path's
+        first-choices-first fill — outputs are identical whenever
+        capacity drops nothing."""
+        c = self.config
+        s = x.shape[0]
+        e, k = c.n_expert, c.expert_top_k
+        cap = capacity or max(1, int(c.capacity_factor * k * s / e))
+        gate_vals, expert_idx, aux = self._router(x, router_w)
+
+        flat_e = expert_idx.reshape(-1)              # (S*k,) token-major
+        order = jnp.argsort(flat_e, stable=True)
+        sorted_e = flat_e[order]
+        counts = jnp.sum(
+            jax.nn.one_hot(flat_e, e, dtype=jnp.int32), axis=0)  # (E,)
+        starts = jnp.cumsum(counts) - counts
+        pos_in_e = jnp.arange(s * k, dtype=jnp.int32) - starts[sorted_e]
+        keep = pos_in_e < cap
+        # kept slots are unique; overflow entries all land on dump slot E*C
+        slot = jnp.where(keep, sorted_e * cap + pos_in_e, e * cap)
+        tok = (order // k).astype(jnp.int32)
+        gate = gate_vals.reshape(-1)[order]
+        src = jnp.full((e * cap + 1,), s, jnp.int32).at[slot].set(
+            jnp.where(keep, tok, s))[: e * cap]
+        gate_tab = jnp.zeros((e * cap + 1,), jnp.float32).at[slot].set(
+            jnp.where(keep, gate, 0.0))[: e * cap]
+        return src, gate_tab, aux
 
     # -- forward -----------------------------------------------------------
 
@@ -213,6 +267,17 @@ class MoEGPT(GPT2Model):
         c = self.config
         b, t, d = x.shape
         xs = x.reshape(b * t, d)
+        if c.moe_dispatch not in ("einsum", "sort"):
+            raise ValueError(
+                f"moe_dispatch={c.moe_dispatch!r}: expected 'einsum' or "
+                "'sort' (a typo here would silently run the einsum path "
+                "while being recorded as a sort A/B)")
+        ep = pctx is not None and pctx.expert_parallel
+        if c.moe_dispatch == "sort" and not ep:
+            # gather/scatter dispatch: skips the two dense (S,E*C,D)
+            # one-hot matmuls (config docstring); EP stays on the einsum
+            # path — that contraction is what GSPMD turns into the a2a
+            return self._moe_mlp_sort(xs, bp, b, t, d, pctx, capacity)
         dispatch, combine, aux = self._route(
             xs.astype(jnp.float32), bp["moe.router.w"].astype(jnp.float32),
             capacity=capacity,
@@ -220,11 +285,19 @@ class MoEGPT(GPT2Model):
         dispatch = dispatch.astype(x.dtype)
         # (S,E,C) x (S,D) -> (E,C,D): the all-to-all boundary under EP
         xe = jnp.einsum("sec,sd->ecd", dispatch, xs)
-        if pctx is not None and pctx.expert_parallel:
+        if ep:
             from jax.sharding import NamedSharding, PartitionSpec as P
             xe = jax.lax.with_sharding_constraint(
                 xe, NamedSharding(pctx.mesh, P(pctx.expert_axis, None, None))
             )
+        ye = self._expert_ffn(xe, bp, pctx)
+        y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), ye)
+        return y.reshape(b, t, d), aux
+
+    def _expert_ffn(self, xe, bp, pctx=None):
+        """(E, C, D) -> (E, C, D): the expert MLP body, shared by both
+        dispatch mechanisms (pctx threads the TP placement and the fp8
+        gather constraint through _bw for BOTH paths)."""
         h = jnp.einsum("ecd,edf->ecf", xe, self._bw(bp, "moe.fc.w", pctx))
         if "moe.fc.b" in bp:
             h = h + bp["moe.fc.b"][:, None]
@@ -232,8 +305,25 @@ class MoEGPT(GPT2Model):
         ye = jnp.einsum("ecf,efd->ecd", h, self._bw(bp, "moe.proj.w", pctx))
         if "moe.proj.b" in bp:
             ye = ye + bp["moe.proj.b"][:, None]
-        y = jnp.einsum("sec,ecd->sd", combine.astype(x.dtype), ye)
-        return y.reshape(b, t, d), aux
+        return ye
+
+    def _moe_mlp_sort(self, xs, bp, b, t, d, pctx=None, capacity=None):
+        """moe_dispatch="sort" body: gather rows per expert slot, run the
+        same (E, C, D) expert einsums, scatter-add weighted outputs."""
+        c = self.config
+        s = b * t
+        e = c.n_expert
+        src, gate, aux = self._route_sort(
+            xs.astype(jnp.float32), bp["moe.router.w"].astype(jnp.float32),
+            capacity=capacity,
+        )
+        cap = src.shape[0] // e
+        xpad = jnp.concatenate([xs, jnp.zeros((1, d), xs.dtype)])
+        xe = xpad[src].reshape(e, cap, d)        # empty slots -> zero row
+        ye = self._expert_ffn(xe, bp, pctx)
+        contrib = gate[:, None].astype(ye.dtype) * ye.reshape(e * cap, d)
+        y = jnp.zeros((s + 1, d), ye.dtype).at[src].add(contrib)[:s]
+        return y.astype(xs.dtype).reshape(b, t, d), aux
 
     def _block(self, x, bp, pctx=None, return_kv=False):
         """Pre-LN block: attention + MoE MLP.  Returns (x, aux)."""
