@@ -75,4 +75,8 @@ log "11. MoE sort-dispatch A/B (round-4 experiment, MoEConfig.moe_dispatch)"
 timeout 1800 env BENCH_MODEL=moe-8x124m BENCH_MOE_DISPATCH=sort python bench.py > "$OUT/bench_moe_sort.json" 2> "$OUT/bench_moe_sort.err"
 log "   rc=$? $(cat "$OUT/bench_moe_sort.json" 2>/dev/null | head -c 200)"
 
+log "12. per-op profile of the default step (scripts/profile_step.py)"
+timeout 1200 python scripts/profile_step.py --out "$OUT/xplane" > "$OUT/profile_buckets.json" 2> "$OUT/profile_buckets.err"
+log "   rc=$? $(cat "$OUT/profile_buckets.json" 2>/dev/null | head -c 300)"
+
 log "batch complete; results in $OUT"
