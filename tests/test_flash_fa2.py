@@ -108,8 +108,10 @@ class TestFA2:
 
     def test_bthd_layout_matches_bhtd(self):
         """The heads-last entry must be bit-for-bit the standard entry's
-        result transposed — fwd and all three grads (same kernels, only
-        the BlockSpec addressing differs)."""
+        result transposed — fwd and all three grads.  The _ah kernels
+        loop heads statically over whole (T, H*Dh) panels but perform
+        the identical f32 operation sequence per head, so exact equality
+        is the contract, not an accident."""
         from tiny_deepspeed_tpu.ops.flash_fa2 import fa2_flash_attention_bthd
         q, k, v = (_rand((2, 2, 256, 64), i) for i in range(3))
         qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # (B, T, H, Dh)
@@ -125,6 +127,28 @@ class TestFA2:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b.swapaxes(1, 2)),
                 rtol=1e-6, atol=1e-7, err_msg=f"d{name}")
+
+    def test_bthd_fallback_past_vmem_budget(self, monkeypatch):
+        """Past _AH_MAX_T_HD the entry transposes over to the standard
+        kernels — same numbers, different plumbing."""
+        from tiny_deepspeed_tpu.ops.flash_fa2 import fa2_flash_attention_bthd
+        monkeypatch.setattr(flash_fa2, "_AH_MAX_T_HD", 1)  # force fallback
+        q, k, v = (_rand((1, 2, 256, 64), i) for i in range(3))
+        qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
+        np.testing.assert_array_equal(
+            np.asarray(fa2_flash_attention_bthd(qt, kt, vt, 128, 128)
+                       .swapaxes(1, 2)),
+            np.asarray(fa2_flash_attention(q, k, v, 128, 128)))
+        g_hl = jax.grad(lambda *a: jnp.sum(
+            fa2_flash_attention_bthd(*a, 128, 128) ** 2),
+            argnums=(0, 1, 2))(qt, kt, vt)
+        g_std = jax.grad(lambda *a: jnp.sum(
+            fa2_flash_attention(*a, 128, 128) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_std, g_hl):
+            np.testing.assert_allclose(np.asarray(a),
+                                       np.asarray(b.swapaxes(1, 2)),
+                                       rtol=1e-6, atol=1e-7)
 
     def test_lse_residual_shape(self):
         """The whole point: the stashed stat is ONE (B*H, 1, T) f32 tensor."""

@@ -65,23 +65,10 @@ def _causal_mask(s, iq, jk, bq, bk):
 # forward
 # ---------------------------------------------------------------------------
 
-def _rd(ref, hl, sl=None):
-    """(X, d) panel from a (1, X, d) ref — or (1, X, 1, d) when heads-last."""
-    sl = slice(None) if sl is None else sl
-    return ref[0, sl, 0, :] if hl else ref[0, sl, :]
-
-
-def _wr(ref, hl, val):
-    if hl:
-        ref[0, :, 0, :] = val
-    else:
-        ref[0] = val
-
-
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
-                *, scale, bq, bk, hl=False):
+                *, scale, bq, bk):
     iq = pl.program_id(1)
-    q = _rd(q_ref, hl).astype(jnp.float32)  # (bq, d)
+    q = q_ref[0].astype(jnp.float32)  # (bq, d)
 
     acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -92,8 +79,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
     ndiag = pl.cdiv((iq + 1) * bq, bk)
 
     def step(jk, m, l, masked):
-        k = _rd(k_ref, hl, pl.ds(jk * bk, bk)).astype(jnp.float32)
-        v = _rd(v_ref, hl, pl.ds(jk * bk, bk)).astype(jnp.float32)
+        k = k_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -115,39 +102,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
     m, l = jax.lax.fori_loop(
         nfull, ndiag, lambda jk, c: step(jk, *c, masked=True), (m, l))
 
-    _wr(o_ref, hl, (acc_ref[:] / l[:, None]).astype(o_ref.dtype))
+    o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
     lse_ref[0, 0] = m + jnp.log(l)
 
 
-def _specs(*, heads, t, d, size):
-    """BlockSpec for one q/k/v/o/grad panel operand.
-
-    Standard layout: array (bh, t, d), block (1, size, d) at (b, i_or_0, 0).
-    Heads-last: array (B, t, H, d), block (1, size, 1, d) — the head axis
-    is addressed by the index map (no XLA transpose ever materializes).
-    `size` None means the full-T panel (index pinned to 0)."""
-    h = heads
+def _specs(*, t, d, size):
+    """BlockSpec for one (bh, t, d) q/k/v/o/grad panel operand: block
+    (1, size, d); `size` None means the full-T panel (index pinned 0)."""
     if size is None:
-        if h is None:
-            return pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
-        return pl.BlockSpec((1, t, 1, d), lambda b, i: (b // h, 0, b % h, 0))
-    if h is None:
-        return pl.BlockSpec((1, size, d), lambda b, i: (b, i, 0))
-    return pl.BlockSpec((1, size, 1, d), lambda b, i: (b // h, i, b % h, 0))
+        return pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
+    return pl.BlockSpec((1, size, d), lambda b, i: (b, i, 0))
 
 
-def _fwd(q, k, v, *, scale, bq, bk, heads=None):
-    if heads is None:
-        bh, t, d = q.shape
-        oshape = (bh, t, d)
-    else:
-        b_, t, h_, d = q.shape
-        bh = b_ * h_
-        oshape = (b_, t, h_, d)
-    sp = functools.partial(_specs, heads=heads, t=t, d=d)
+def _fwd(q, k, v, *, scale, bq, bk):
+    bh, t, d = q.shape
+    oshape = (bh, t, d)
+    sp = functools.partial(_specs, t=t, d=d)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk,
-                          hl=heads is not None),
+        functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk),
         grid=(bh, t // bq),
         in_specs=[sp(size=bq), sp(size=None), sp(size=None)],
         out_specs=[
@@ -171,11 +143,10 @@ def _fwd(q, k, v, *, scale, bq, bk, heads=None):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, bq, bk,
-                    hl=False):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, bq, bk):
     jk = pl.program_id(1)
-    k = _rd(k_ref, hl).astype(jnp.float32)   # (bk, d)
-    v = _rd(v_ref, hl).astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)   # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
 
     dk_acc[:] = jnp.zeros_like(dk_acc)
     dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -185,8 +156,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
     idiag_end = pl.cdiv((jk + 1) * bk, bq)  # first FULLY-unmasked q-block
 
     def body(iq, masked):
-        q = _rd(q_ref, hl, pl.ds(iq * bq, bq)).astype(jnp.float32)
-        do = _rd(do_ref, hl, pl.ds(iq * bq, bq)).astype(jnp.float32)
+        q = q_ref[0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(iq * bq, bq)]
         di = di_ref[0, 0, pl.ds(iq * bq, bq)]
         s = jax.lax.dot_general(
@@ -211,15 +182,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                       lambda i, c: body(i, masked=True), 0)
     jax.lax.fori_loop(idiag_end, nq,
                       lambda i, c: body(i, masked=False), 0)
-    _wr(dk_ref, hl, dk_acc[:].astype(dk_ref.dtype))
-    _wr(dv_ref, hl, dv_acc[:].astype(dv_ref.dtype))
+    dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
-                   dq_ref, dq_acc, *, scale, bq, bk, hl=False):
+                   dq_ref, dq_acc, *, scale, bq, bk):
     iq = pl.program_id(1)
-    q = _rd(q_ref, hl).astype(jnp.float32)
-    do = _rd(do_ref, hl).astype(jnp.float32)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0]
     di = di_ref[0, 0]
 
@@ -228,8 +199,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
     ndiag = pl.cdiv((iq + 1) * bq, bk)
 
     def body(jk, masked):
-        k = _rd(k_ref, hl, pl.ds(jk * bk, bk)).astype(jnp.float32)
-        v = _rd(v_ref, hl, pl.ds(jk * bk, bk)).astype(jnp.float32)
+        k = k_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -247,35 +218,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     jax.lax.fori_loop(0, nfull, lambda j, c: body(j, masked=False), 0)
     jax.lax.fori_loop(nfull, ndiag, lambda j, c: body(j, masked=True), 0)
-    _wr(dq_ref, hl, dq_acc[:].astype(dq_ref.dtype))
+    dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd(res, g, *, scale, bq, bk, heads=None):
+def _bwd(res, g, *, scale, bq, bk):
     q, k, v, o, lse = res
-    if heads is None:
-        bh, t, d = q.shape
-        pshape = (bh, t, d)
-    else:
-        b_, t, h_, d = q.shape
-        bh = b_ * h_
-        pshape = (b_, t, h_, d)
+    bh, t, d = q.shape
+    pshape = (bh, t, d)
     do = g
     # di = rowsum(do * o): one fused elementwise+reduce in XLA, (bh, 1, t)
     # f32 — consumed directly by both kernels, never broadcast to block
-    # width.  Heads-last: the (B, t, H) reduce lands as (bh, 1, t) via a
-    # cheap f32 transpose (7 MB at the 124M shape, vs the bf16 panel
-    # transposes this layout exists to delete).
-    di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    if heads is None:
-        di = di[:, None, :]
-    else:
-        di = di.transpose(0, 2, 1).reshape(bh, 1, t)
-    sp = functools.partial(_specs, heads=heads, t=t, d=d)
-    hl = heads is not None
+    # width
+    di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                 axis=-1)[:, None, :]
+    sp = functools.partial(_specs, t=t, d=d)
 
     stat_full = pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk, hl=hl),
+        functools.partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk),
         grid=(bh, t // bk),
         in_specs=[sp(size=None),   # q (full)
                   sp(size=bk),     # k (block)
@@ -297,7 +257,7 @@ def _bwd(res, g, *, scale, bq, bk, heads=None):
 
     stat_blk = pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i))
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk, hl=hl),
+        functools.partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk),
         grid=(bh, t // bq),
         in_specs=[sp(size=bq),     # q (block)
                   sp(size=None),   # k (full)
@@ -356,38 +316,271 @@ fa2_flash_attention.defvjp(_fa2_fwd, _fa2_bwd)
 # ---------------------------------------------------------------------------
 # heads-last entry (B, T, H, Dh) — EXPERIMENTAL, not wired into dispatch
 # ---------------------------------------------------------------------------
+#
+# Motivation: the round-4 chip profile priced the per-layer
+# (B,T,H,Dh)->(B,H,T,Dh) copies around the attention kernel at ~8.4 ms of
+# the 95 ms gpt2-124m step.  A first attempt addressed the head axis in
+# per-head BlockSpec index maps — REJECTED by Mosaic's tiling rule (the
+# size-1 head block lands in the sublane position, which must be
+# divisible by 8 or the full dim; caught by the local v5e AOT compile).
+# This implementation instead reads the WHOLE (T, H*Dh) panel per batch
+# element — minor dim H*Dh is the full array dim, so the rule is
+# satisfied — and loops the heads statically inside the kernel, slicing
+# 64-lane head columns in VMEM.  Zero XLA transposes; the open question
+# (chip A/B, scripts/fa2_bthd_ab.py) is whether the in-kernel sub-128
+# lane slices cost more relayout than the deleted copies.
+#
+# VMEM: panels are (T, H*Dh) bf16 — 1.5 MB at the 124M shape; the bwd
+# holds four of them plus f32 scratch, so the entry transposes over to
+# the standard kernels past _AH_MAX_T_HD elements.
+
+_AH_MAX_T_HD = 4 * 1024 * 1024  # t * h * d bound for the all-heads path
+
+
+def _fwd_kernel_ah(q_ref, k_ref, v_ref, o_ref, lse_ref, o_acc,
+                   *, scale, bq, bk, h):
+    iq = pl.program_id(1)
+    hd = q_ref.shape[-1]
+    d = hd // h
+    nfull = iq * bq // bk
+    ndiag = pl.cdiv((iq + 1) * bq, bk)
+
+    for hh in range(h):  # static unroll over heads
+        sl = slice(hh * d, (hh + 1) * d)
+        q = q_ref[0, :, sl].astype(jnp.float32)      # (bq, d)
+
+        def step(jk, carry, masked):
+            m, l, acc = carry
+            k = k_ref[0, pl.ds(jk * bk, bk), sl].astype(jnp.float32)
+            v = v_ref[0, pl.ds(jk * bk, bk), sl].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if masked:
+                s = _causal_mask(s, iq, jk, bq, bk)
+            m_cur = jnp.maximum(m, jnp.max(s, axis=1))
+            alpha = jnp.exp(m - m_cur)
+            p = jnp.exp(s - m_cur[:, None])
+            l = l * alpha + jnp.sum(p, axis=1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_cur, l, acc
+
+        m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((bq,), jnp.float32)
+        a0 = jnp.zeros((bq, d), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(
+            0, nfull, lambda jk, c: step(jk, c, masked=False), (m0, l0, a0))
+        m, l, acc = jax.lax.fori_loop(
+            nfull, ndiag, lambda jk, c: step(jk, c, masked=True), (m, l, acc))
+        o_acc[:, sl] = acc / l[:, None]
+        lse_ref[0, hh] = m + jnp.log(l)
+
+    o_ref[0] = o_acc[:].astype(o_ref.dtype)
+
+
+def _bwd_dkv_kernel_ah(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *, scale, bq, bk, h):
+    jk = pl.program_id(1)
+    hd = q_ref.shape[-1]
+    d = hd // h
+    nq = q_ref.shape[1] // bq
+    first = jk * bk // bq
+    idiag_end = pl.cdiv((jk + 1) * bk, bq)
+
+    for hh in range(h):
+        sl = slice(hh * d, (hh + 1) * d)
+        k = k_ref[0, :, sl].astype(jnp.float32)      # (bk, d)
+        v = v_ref[0, :, sl].astype(jnp.float32)
+
+        def body(iq, carry, masked):
+            dk_c, dv_c = carry
+            q = q_ref[0, pl.ds(iq * bq, bq), sl].astype(jnp.float32)
+            do = do_ref[0, pl.ds(iq * bq, bq), sl].astype(jnp.float32)
+            lse = lse_ref[0, hh, pl.ds(iq * bq, bq)]
+            di = di_ref[0, hh, pl.ds(iq * bq, bq)]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if masked:
+                s = _causal_mask(s, iq, jk, bq, bk)
+            p = jnp.exp(s - lse[:, None])
+            dv_c = dv_c + jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - di[:, None]) * scale
+            dk_c = dk_c + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk_c, dv_c
+
+        z = jnp.zeros((bk, d), jnp.float32)
+        dk_c, dv_c = jax.lax.fori_loop(
+            first, idiag_end, lambda i, c: body(i, c, masked=True), (z, z))
+        dk_c, dv_c = jax.lax.fori_loop(
+            idiag_end, nq, lambda i, c: body(i, c, masked=False),
+            (dk_c, dv_c))
+        dk_acc[:, sl] = dk_c
+        dv_acc[:, sl] = dv_c
+
+    dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel_ah(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                      dq_ref, dq_acc, *, scale, bq, bk, h):
+    iq = pl.program_id(1)
+    hd = q_ref.shape[-1]
+    d = hd // h
+    nfull = iq * bq // bk
+    ndiag = pl.cdiv((iq + 1) * bq, bk)
+
+    for hh in range(h):
+        sl = slice(hh * d, (hh + 1) * d)
+        q = q_ref[0, :, sl].astype(jnp.float32)
+        do = do_ref[0, :, sl].astype(jnp.float32)
+        lse = lse_ref[0, hh]
+        di = di_ref[0, hh]
+
+        def body(jk, dq_c, masked):
+            k = k_ref[0, pl.ds(jk * bk, bk), sl].astype(jnp.float32)
+            v = v_ref[0, pl.ds(jk * bk, bk), sl].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if masked:
+                s = _causal_mask(s, iq, jk, bq, bk)
+            p = jnp.exp(s - lse[:, None])
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - di[:, None]) * scale
+            return dq_c + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        dq_c = jax.lax.fori_loop(
+            0, nfull, lambda j, c: body(j, c, masked=False),
+            jnp.zeros((bq, d), jnp.float32))
+        dq_c = jax.lax.fori_loop(
+            nfull, ndiag, lambda j, c: body(j, c, masked=True), dq_c)
+        dq_acc[:, sl] = dq_c
+
+    dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _ah_specs(t, hd, size):
+    if size is None:
+        return pl.BlockSpec((1, t, hd), lambda b, i: (b, 0, 0))
+    return pl.BlockSpec((1, size, hd), lambda b, i: (b, i, 0))
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def fa2_flash_attention_bthd(q, k, v, block_q: int = 512,
                              block_k: int = 512):
     """Causal FA2 on (B, T, H, Dh) tensors — the layout the QKV matmul
-    produces — addressing the head axis in the kernel's BlockSpec index
-    maps instead of transposing to (B, H, T, Dh) first.  Motivation: the
-    round-4 chip profile priced the per-layer (B,T,H,Dh)->(B,H,T,Dh)
-    copies at ~8.4 ms of the 95 ms gpt2-124m step; this entry would
-    delete them.  Semantics parity with `fa2_flash_attention` is pinned
-    in tests/test_flash_fa2.py (interpret mode); its CHIP timing could
-    not be taken before the round-4 tunnel outage, so it is not the
-    dispatch default — scripts/fa2_bthd_ab.py runs the A/B when the
-    tunnel answers (wired into scripts/tpu_batch.sh)."""
+    produces — with the heads looped statically INSIDE the kernel over
+    whole (T, H*Dh) panels, so no (B,T,H,Dh)->(B,H,T,Dh) XLA transpose
+    ever materializes (see the section comment above for why per-head
+    blocks cannot lower).  Semantics parity with `fa2_flash_attention`
+    is pinned in tests/test_flash_fa2.py; chip timing pending
+    (scripts/fa2_bthd_ab.py, tpu_batch.sh step 10).  Falls back to
+    transpose + the standard kernels when the panel exceeds the VMEM
+    budget."""
     out, _ = _fa2_bthd_fwd(q, k, v, block_q, block_k)
     return out
 
 
+def _use_ah(q):
+    b, t, h, d = q.shape
+    return t * h * d <= _AH_MAX_T_HD
+
+
 def _fa2_bthd_fwd(q, k, v, block_q, block_k):
-    t, h = q.shape[1], q.shape[2]
+    b, t, h, d = q.shape
+    if not _use_ah(q):
+        # residuals stay (B, T, H, Dh) so the bwd fallback's transposes
+        # are unconditional; only lse keeps the standard (B*H, 1, T) form
+        tr = lambda x: x.swapaxes(1, 2)
+        o, (*_, lse) = _fa2_fwd(tr(q), tr(k), tr(v), block_q, block_k)
+        o_t = tr(o)
+        return o_t, (q, k, v, o_t, lse)
     bq, bk = _pick(t, block_q), _pick(t, block_k)
-    scale = 1.0 / math.sqrt(q.shape[3])
-    o, lse = _fwd(q, k, v, scale=scale, bq=bq, bk=bk, heads=h)
+    scale = 1.0 / math.sqrt(d)
+    hd = h * d
+    flat = lambda x: x.reshape(b, t, hd)
+    sp = functools.partial(_ah_specs, t, hd)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_ah, scale=scale, bq=bq, bk=bk, h=h),
+        grid=(b, t // bq),
+        in_specs=[sp(bq), sp(None), sp(None)],
+        out_specs=[
+            sp(bq),
+            pl.BlockSpec((1, h, bq), lambda b_, i: (b_, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
+        interpret=_INTERPRET,
+    )(flat(q), flat(k), flat(v))
+    o = o.reshape(b, t, h, d)
     return o, (q, k, v, o, lse)
 
 
 def _fa2_bthd_bwd(block_q, block_k, res, g):
-    q = res[0]
-    t, h = q.shape[1], q.shape[2]
+    q, k, v, o, lse = res
+    if not _use_ah(q):
+        tr = lambda x: x.swapaxes(1, 2)
+        dq, dk, dv = _fa2_bwd(block_q, block_k,
+                              (tr(q), tr(k), tr(v), tr(o), lse), tr(g))
+        return tr(dq), tr(dk), tr(dv)
+    b, t, h, d = q.shape
     bq, bk = _pick(t, block_q), _pick(t, block_k)
-    scale = 1.0 / math.sqrt(q.shape[3])
-    return _bwd(res, g, scale=scale, bq=bq, bk=bk, heads=h)
+    scale = 1.0 / math.sqrt(d)
+    hd = h * d
+    flat = lambda x: x.reshape(b, t, hd)
+    do = flat(g)
+    # di = rowsum(do * o) per head: (B, T, H) -> (B, H, T), f32 — tiny
+    # next to the bf16 panel transposes this path exists to delete
+    di = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                 axis=-1).transpose(0, 2, 1)
+    sp = functools.partial(_ah_specs, t, hd)
+    stat_full = pl.BlockSpec((1, h, t), lambda b_, j: (b_, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_ah, scale=scale, bq=bq, bk=bk,
+                          h=h),
+        grid=(b, t // bk),
+        in_specs=[sp(None), sp(bk), sp(bk), sp(None), stat_full, stat_full],
+        out_specs=[sp(bk), sp(bk)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, hd), k.dtype),
+            jax.ShapeDtypeStruct((b, t, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, hd), jnp.float32),
+            pltpu.VMEM((bk, hd), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(flat(q), flat(k), flat(v), do, lse, di)
+    stat_blk = pl.BlockSpec((1, h, bq), lambda b_, i: (b_, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_ah, scale=scale, bq=bq, bk=bk,
+                          h=h),
+        grid=(b, t // bq),
+        in_specs=[sp(bq), sp(None), sp(None), sp(bq), stat_blk, stat_blk],
+        out_specs=sp(bq),
+        out_shape=jax.ShapeDtypeStruct((b, t, hd), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
+        interpret=_INTERPRET,
+    )(flat(q), flat(k), flat(v), do, lse, di)
+    unflat = lambda x: x.reshape(b, t, h, d)
+    return unflat(dq), unflat(dk), unflat(dv)
 
 
 fa2_flash_attention_bthd.defvjp(_fa2_bthd_fwd, _fa2_bthd_bwd)
