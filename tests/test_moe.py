@@ -135,14 +135,17 @@ class TestSortDispatch:
         losses, _ = run_steps(eng, n=4)
         assert all(np.isfinite(losses))
 
-    def test_ep_falls_back_to_einsum(self):
-        """Under expert parallelism the sort knob is inert (the einsum
-        contraction is the all-to-all boundary) — same loss as einsum EP."""
+    @pytest.mark.parametrize("ep", [2, 1])
+    def test_multi_device_falls_back_to_einsum(self, ep):
+        """On ANY multi-device mesh the sort knob is inert — under EP the
+        einsum contraction is the all-to-all boundary, and under plain DP
+        a global argsort over the sharded token axis would force
+        cross-device gathers — so the loss must match einsum exactly."""
         import dataclasses
         from tiny_deepspeed_tpu import Zero1
         cfg_s = dataclasses.replace(CFG, moe_dispatch="sort")
-        e1 = Zero1(MoEGPT(CFG), AdamW(lr=1e-3), expert_parallel=2)
-        e2 = Zero1(MoEGPT(cfg_s), AdamW(lr=1e-3), expert_parallel=2)
+        e1 = Zero1(MoEGPT(CFG), AdamW(lr=1e-3), expert_parallel=ep)
+        e2 = Zero1(MoEGPT(cfg_s), AdamW(lr=1e-3), expert_parallel=ep)
         (l1, *_), _ = run_steps(e1, n=1)
         (l2, *_), _ = run_steps(e2, n=1)
         assert abs(l1 - l2) < 1e-5

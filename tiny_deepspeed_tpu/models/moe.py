@@ -52,8 +52,10 @@ class MoEConfig(GPTConfig):
     # FLOPs per layer — at moe-8x124m bench shape ~2/3 of the expert
     # matmul FLOPs themselves, none of it counted as model compute — while
     # the sort path moves the same rows with O(S*k log) sort + gather.
-    # "sort" is single-device/DP only (under EP the einsum contraction IS
-    # what GSPMD turns into the all-to-all; _moe_mlp falls back).  Slot
+    # "sort" is single-device only (_moe_mlp falls back on any
+    # multi-device mesh: under EP the einsum contraction IS what GSPMD
+    # turns into the all-to-all, and a global argsort over a sharded
+    # token axis would force cross-device gathers).  Slot
     # assignment differs under capacity overflow: einsum fills all 1st
     # choices before 2nd choices, sort fills token-major — identical
     # outputs whenever nothing drops (pinned by test).
@@ -273,10 +275,14 @@ class MoEGPT(GPT2Model):
                 "'sort' (a typo here would silently run the einsum path "
                 "while being recorded as a sort A/B)")
         ep = pctx is not None and pctx.expert_parallel
-        if c.moe_dispatch == "sort" and not ep:
+        multi = pctx is not None and pctx.is_multi_device
+        if c.moe_dispatch == "sort" and not multi:
             # gather/scatter dispatch: skips the two dense (S,E*C,D)
-            # one-hot matmuls (config docstring); EP stays on the einsum
-            # path — that contraction is what GSPMD turns into the a2a
+            # one-hot matmuls (config docstring).  Single-device only:
+            # under EP the einsum contraction IS what GSPMD turns into
+            # the all-to-all, and under plain DP/ZeRO the global argsort
+            # over the batch-sharded token axis would force cross-device
+            # gathers the einsum path never needs
             return self._moe_mlp_sort(xs, bp, b, t, d, pctx, capacity)
         dispatch, combine, aux = self._route(
             xs.astype(jnp.float32), bp["moe.router.w"].astype(jnp.float32),
