@@ -81,6 +81,21 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "model.py:79-81)",
     )
     p.add_argument(
+        "--scan-unroll", action="store_true",
+        help="fully unroll the transformer layer stack instead of "
+             "lax.scan-ning it — deletes the scan's activation-stash "
+             "slice traffic (round-4 chip profile: +16%% on gpt2-124m; "
+             "BASELINE.md).  Avoid with ZeRO-3 (the scan bounds live "
+             "gathered weights; the engine warns) and with very deep "
+             "models (compile time grows with depth)",
+    )
+    p.add_argument(
+        "--moe-dispatch", choices=("einsum", "sort"), default=None,
+        help="MoE families only: token dispatch mechanism "
+             "(MoEConfig.moe_dispatch — 'sort' skips the dense one-hot "
+             "dispatch matmuls on single device)",
+    )
+    p.add_argument(
         "--gather-quant", choices=("fp8",), default=None,
         help="ZeRO++-style quantized weight gather (EXPERIMENTAL): block "
              "weights stack as float8_e4m3 + per-channel scales so the "
@@ -233,6 +248,10 @@ def run(engine_cls, args, single_device=False):
         model_cfg = _cfg_override("dropout", args.dropout)
     if getattr(args, "gather_quant", None):
         model_cfg = _cfg_override("gather_quant", args.gather_quant)
+    if getattr(args, "scan_unroll", False):
+        model_cfg = _cfg_override("scan_unroll", True)
+    if getattr(args, "moe_dispatch", None):
+        model_cfg = _cfg_override("moe_dispatch", args.moe_dispatch)
     model = build_model(model_cfg)
 
     lr = args.lr
