@@ -86,7 +86,12 @@ def main():
     xplane = sorted(glob.glob(
         os.path.join(args.out, "plugins/profile/*/*.xplane.pb")))[-1]
     p = ProfileData.from_file(xplane)
-    tpu = next(pl for pl in p.planes if "TPU" in pl.name)
+    tpu = next((pl for pl in p.planes if "TPU" in pl.name), None)
+    if tpu is None:
+        raise SystemExit(
+            f"no TPU plane in {xplane} (planes: "
+            f"{[pl.name for pl in p.planes]}) — this script needs the "
+            "real chip; the CPU backend records no per-op device line")
     ops = next(ln for ln in tpu.lines if ln.name == "XLA Ops")
     tot = defaultdict(float)
     for e in ops.events:
