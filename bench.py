@@ -71,6 +71,26 @@ def _config_fingerprint(env=None) -> str:
 _DEFAULT_FINGERPRINT = _config_fingerprint(env={})
 
 
+def _fingerprints_match(stored: str) -> bool:
+    """Stored-vs-current fingerprint equality with ABSENT KEYS AS
+    DEFAULTS: adding a knob to _config_fingerprint must not invalidate
+    records saved before the knob existed (round 4 nearly repeated the
+    0.0-at-round-end failure this cache exists to prevent: adding
+    moe_dispatch to the list made the committed record's fingerprint
+    string-unequal to the current one while the measured config was
+    semantically identical)."""
+    try:
+        a, b = json.loads(stored), json.loads(_config_fingerprint())
+    except (ValueError, TypeError):
+        return False
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return False  # corrupted/hand-edited committed record: no replay
+    keys = set(a) | set(b)
+    defaults = json.loads(_DEFAULT_FINGERPRINT)
+    return all(a.get(k, defaults.get(k, "")) == b.get(k, defaults.get(k, ""))
+               for k in keys)
+
+
 def _default_config() -> bool:
     """ONE predicate for both the save and load sites: the cache holds only
     the canonical default invocation (round-3 advice: a tuned-program run
@@ -122,7 +142,7 @@ def _load_last_good():
         if not rec.get("value"):
             return None
         fp = rec.get("config_fingerprint")
-        if fp is not None and fp != _config_fingerprint():
+        if fp is not None and not _fingerprints_match(fp):
             return None
         age = time.time() - rec.get("measured_at_epoch", 0)
         return rec, age > MAX_CACHE_AGE_S

@@ -29,7 +29,8 @@ def bench(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "LAST_GOOD",
                         str(tmp_path / "last_good.json"))
     for var in ("BENCH_BATCH", "BENCH_SEQ", "BENCH_DECODE", "BENCH_MODEL",
-                "BENCH_ATTEMPT", "BENCH_OFFLOAD", "BENCH_AUTOTUNE"):
+                "BENCH_ATTEMPT", "BENCH_OFFLOAD", "BENCH_AUTOTUNE",
+                "BENCH_MOE_DISPATCH"):
         monkeypatch.delenv(var, raising=False)
     return mod
 
@@ -100,6 +101,44 @@ class TestDiagnose:
 
 
 class TestCache:
+    def test_pre_knob_record_still_replays(self, bench, capsys,
+                                           monkeypatch):
+        """Adding a knob to _config_fingerprint must NOT invalidate
+        records saved before the knob existed (round 4: adding
+        moe_dispatch made the committed record string-unequal and the
+        replay path silently returned 0.0 — the exact failure the cache
+        exists to prevent).  Absent keys compare as the knob default; a
+        CURRENT non-default knob still blocks the replay, and a
+        corrupted fingerprint (non-dict JSON) never replays or raises."""
+        import json as _json
+        bench._save_last_good({
+            "metric": "gpt2-124m_train_tokens_per_sec_per_chip",
+            "value": 88000.0, "unit": "tokens/s/chip", "vs_baseline": 1.0,
+        })
+        # simulate "saved before the newest knob existed": drop one key
+        rec = _json.load(open(bench.LAST_GOOD))
+        fp = _json.loads(rec["config_fingerprint"])
+        fp.pop("moe_dispatch")
+        rec["config_fingerprint"] = _json.dumps(fp, sort_keys=True)
+        _json.dump(rec, open(bench.LAST_GOOD, "w"))
+        monkeypatch.setenv("BENCH_ATTEMPT", str(bench.MAX_ATTEMPTS))
+        out = _diagnose(bench, RuntimeError("UNAVAILABLE: hung"), capsys)
+        assert out["value"] == 88000.0          # replays despite old format
+        # but a CURRENT non-default knob still blocks the replay
+        monkeypatch.setenv("BENCH_MOE_DISPATCH", "sort")
+        out = _diagnose(bench, RuntimeError("UNAVAILABLE: hung"), capsys)
+        assert out["value"] == 0.0
+        monkeypatch.delenv("BENCH_MOE_DISPATCH")
+        # corrupted committed record: no replay, NO exception (driver
+        # contract: one JSON line, rc 0)
+        for bad in (5,        # json.loads(5) -> TypeError
+                    "x",      # invalid JSON -> ValueError
+                    "[]"):    # valid JSON, non-dict -> isinstance guard
+            rec["config_fingerprint"] = bad
+            _json.dump(rec, open(bench.LAST_GOOD, "w"))
+            out = _diagnose(bench, RuntimeError("UNAVAILABLE: hung"), capsys)
+            assert out["value"] == 0.0
+
     def test_roundtrip_and_staleness(self, bench):
         rec = {"metric": "gpt2-124m_train_tokens_per_sec_per_chip",
                "value": 1.0, "unit": "tokens/s/chip", "vs_baseline": 1.0}

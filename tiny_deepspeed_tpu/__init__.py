@@ -47,7 +47,7 @@ from .models import (
 DDPSGD = Zero1SGD = Zero2SGD = Zero3SGD = SGD
 DDPAdamW = Zero1AdamW = Zero2AdamW = Zero3AdamW = AdamW
 
-__version__ = "0.2.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "partition_tensors",
