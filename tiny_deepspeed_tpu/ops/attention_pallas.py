@@ -1,16 +1,19 @@
 # Copyright 2026 tiny-deepspeed-tpu authors
 # SPDX-License-Identifier: Apache-2.0
 
-"""Pallas flash attention for TPU.
+"""Bundled-kernel flash attention wrapper + the tuner candidate registry.
 
 The reference's "flash_attention" is a thin wrapper over torch's
-F.scaled_dot_product_attention (reference example/model.py:44-51).  The TPU
-equivalent wraps JAX's Pallas TPU flash-attention kernel (blockwise
-softmax(QK^T)V with O(T) memory, fwd + bwd kernels), which keeps the
-attention working set in VMEM and avoids materializing the (T, T) score
-matrix in HBM.
+F.scaled_dot_product_attention (reference example/model.py:44-51).  Two
+TPU kernels stand behind the same switch here:
 
-Falls back are handled by the caller (ops/attention.py).
+  * the hand-written FA2 kernel (ops/flash_fa2.py) — FLASH_VARIANTS[0],
+    the measured default at T <= FA2_MAX_T (round 4);
+  * JAX's bundled Pallas flash kernel (blockwise softmax(QK^T)V, O(T)
+    memory), wrapped below with tuned block sizes — the long-T path and
+    the remaining tuner candidates.
+
+Fallbacks are handled by the caller (ops/attention.py).
 """
 
 from __future__ import annotations
