@@ -380,8 +380,11 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         # table and drop the short-context speed knobs — remat back on and
         # the chunked fused head, or the activation/logit memory at long T
         # swamps the chip
+        # scan_unroll back to scanned too: a fully unrolled 12-36 layer
+        # stack at T>=4096 inflates compile time and re-stashes per-layer
+        # activations that the re-enabled remat exists to avoid
         cfg = dataclasses.replace(cfg, block_size=t, remat=True,
-                                  fused_xent=True)
+                                  fused_xent=True, scan_unroll=1)
 
     if os.environ.get("BENCH_AUTOTUNE"):
         # per-shape candidate timing at trace time (linear layouts, flash
@@ -395,6 +398,17 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     model = build_model(cfg)
     devices = jax.devices()
     n_chips = len(devices)
+    # Effective MoE dispatch: the sort knob is inert on multi-device meshes
+    # (moe.py falls back to einsum whenever pctx.is_multi_device, which for
+    # the bench mesh — make_mesh over all devices — is n_chips > 1).  One
+    # predicate feeds both the warning and the record so they can't drift.
+    moe_eff = None
+    if hasattr(cfg, "moe_dispatch"):
+        moe_eff = "einsum" if n_chips > 1 else cfg.moe_dispatch
+        if moe_eff != cfg.moe_dispatch:
+            print(f"bench: moe_dispatch={cfg.moe_dispatch!r} is INERT on a "
+                  f"multi-device mesh; the measurement below is the "
+                  f"{moe_eff} path", file=sys.stderr)
     mesh = make_mesh()
     opt = AdamW(lr=1e-5, weight_decay=0.1,
                 state_dtype=bc["state_dtype"] or jnp.float32)
@@ -520,6 +534,16 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             "mfu_6n": round(mfu_6n, 3),
             "peak_hbm_gb_per_chip": hbm_gb,
             "n_params_m": round(n_params / 1e6, 1),
+            # what actually ran, so an A/B record can't claim a knob value
+            # it never measured: moe_dispatch post-fallback, plus the knobs
+            # the long-context branch silently overrides (the `config` dict
+            # below is the PRE-override _bench_config table)
+            **({"moe_dispatch_effective": moe_eff} if moe_eff else {}),
+            "effective": {
+                "remat": str(cfg.remat),
+                "fused_xent": str(cfg.fused_xent),
+                "scan_unroll": str(cfg.scan_unroll),
+            },
             "config": {
                 k: str(v) for k, v in _bench_config(model_name).items()
             },

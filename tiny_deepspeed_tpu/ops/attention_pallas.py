@@ -106,6 +106,11 @@ FA2_MAX_T = 16384
 # kernel at q512/k512 — +6.4% end-to-end on gpt2-124m over the bundled
 # kernel, BASELINE.md), so frozen/no-tuner dispatch keeps the default
 # behavior; the bundled-kernel blocks stay as real alternatives.
+# Past FA2_MAX_T the two fa2_variant entries fall back to the same
+# bundled-kernel calls as _variant(512,512)/_variant(1024,512) below, so
+# the tuner times two duplicate candidates at long T — harmless (wasted
+# tuning samples only; long T rides ring attention in practice) and
+# cheaper than threading T into list construction.
 FLASH_VARIANTS = [_fa2_variant(512, 512), _fa2_variant(1024, 512),
                   _variant(1024, 512), _variant(512, 512),
                   _variant(1024, 1024)]
