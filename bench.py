@@ -64,6 +64,7 @@ def _config_fingerprint(env=None) -> str:
         "autotune": env.get("BENCH_AUTOTUNE", ""),
         "decode": env.get("BENCH_DECODE", ""),
         "moe_dispatch": env.get("BENCH_MOE_DISPATCH", ""),
+        "gqa": env.get("TINY_DS_GQA", ""),
     }, sort_keys=True)
 
 

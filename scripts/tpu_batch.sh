@@ -75,6 +75,14 @@ log "11. MoE sort-dispatch A/B (round-4 experiment, MoEConfig.moe_dispatch)"
 timeout 1800 env BENCH_MODEL=moe-8x124m BENCH_MOE_DISPATCH=sort python bench.py > "$OUT/bench_moe_sort.json" 2> "$OUT/bench_moe_sort.err"
 log "   rc=$? $(cat "$OUT/bench_moe_sort.json" 2>/dev/null | head -c 200)"
 
+log "11b. GQA-native vs repeat A/B (round-5: ops/flash_fa2.py kv-indexed panels)"
+for m in llama-160m llama-1b; do
+  timeout 1800 env BENCH_MODEL=$m python bench.py > "$OUT/bench_${m}_gqa.json" 2> "$OUT/bench_${m}_gqa.err"
+  log "   $m native rc=$? $(cat "$OUT/bench_${m}_gqa.json" 2>/dev/null | head -c 160)"
+  timeout 1800 env BENCH_MODEL=$m TINY_DS_GQA=repeat python bench.py > "$OUT/bench_${m}_repeat.json" 2> "$OUT/bench_${m}_repeat.err"
+  log "   $m repeat rc=$? $(cat "$OUT/bench_${m}_repeat.json" 2>/dev/null | head -c 160)"
+done
+
 log "12. per-op profile of the default step (scripts/profile_step.py)"
 timeout 1200 python scripts/profile_step.py --out "$OUT/xplane" > "$OUT/profile_buckets.json" 2> "$OUT/profile_buckets.err"
 log "   rc=$? $(cat "$OUT/profile_buckets.json" 2>/dev/null | head -c 300)"

@@ -201,3 +201,33 @@ class TestTpuTopologyHLO:
         # the gathers are issued as async start fusions (overlap evidence)
         assert "%async-collective-start" in text or \
             "async_collective_name" in text
+
+    def test_gqa_fa2_compiles_on_tpu(self, topo_mesh):
+        """Mosaic accepts the GQA kernels' grouped BlockSpecs (interpret
+        mode can't check tiling rules): fwd + both backward passes of the
+        kv-indexed FA2 kernel compile against the v5e target at the two
+        llama preset shapes, and the pallas custom calls are in the
+        program (not silently replaced by an XLA fallback)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from tiny_deepspeed_tpu.ops.flash_fa2 import fa2_flash_attention
+
+        mesh_1 = Mesh(np.array(topo_mesh.devices).reshape(-1)[:1], ("d",))
+        sh = NamedSharding(mesh_1, P())
+        for b, h, kvh, t, d in [(8, 12, 4, 1024, 64), (4, 32, 8, 2048, 64)]:
+            f = jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(
+                    fa2_flash_attention(q, k, v, 512, 512)
+                    .astype(jnp.float32)),
+                argnums=(0, 1, 2)))
+            args = [
+                jax.ShapeDtypeStruct((b, h, t, d), jnp.bfloat16, sharding=sh),
+                jax.ShapeDtypeStruct((b, kvh, t, d), jnp.bfloat16,
+                                     sharding=sh),
+                jax.ShapeDtypeStruct((b, kvh, t, d), jnp.bfloat16,
+                                     sharding=sh),
+            ]
+            with kernel_target_forced("tpu"):
+                compiled = f.lower(*args).compile()
+            assert compiled.as_text().count("tpu_custom_call") == 3

@@ -150,6 +150,54 @@ class TestFA2:
                                        np.asarray(b.swapaxes(1, 2)),
                                        rtol=1e-6, atol=1e-7)
 
+    def test_gqa_matches_repeat_path(self):
+        """GQA-native kernel (k/v at KVH heads) vs jnp.repeat + the MHA
+        kernel: forward and all three grads.  dk/dv must come back at
+        KVH heads — the in-kernel group sum is the repeat's vjp."""
+        B, H, KVH, T, D = 2, 6, 2, 256, 64
+        q = _rand((B, H, T, D), 0)
+        k = _rand((B, KVH, T, D), 1)
+        v = _rand((B, KVH, T, D), 2)
+        rep = H // KVH
+
+        def ref(q, k, v):
+            return fa2_flash_attention(
+                q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+                128, 128)
+
+        np.testing.assert_allclose(
+            np.asarray(fa2_flash_attention(q, k, v, 128, 128)),
+            np.asarray(ref(q, k, v)), rtol=1e-6, atol=1e-7)
+        g1 = jax.grad(lambda *a: jnp.sum(fa2_flash_attention(*a, 128, 128) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        assert g1[1].shape == (B, KVH, T, D)
+        assert g1[2].shape == (B, KVH, T, D)
+        for name, a, b in zip("qkv", g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5,
+                err_msg=f"d{name}")
+
+    def test_gqa_uneven_blocks(self):
+        """GQA with block_q != block_k (diagonal-straddling masks) and a
+        group that isn't a power of two (llama-160m's is 3)."""
+        q = _rand((1, 6, 512, 64), 0)
+        k = _rand((1, 2, 512, 64), 1)
+        v = _rand((1, 2, 512, 64), 2)
+        ref = fa2_flash_attention(
+            q, jnp.repeat(k, 3, axis=1), jnp.repeat(v, 3, axis=1), 256, 128)
+        np.testing.assert_allclose(
+            np.asarray(fa2_flash_attention(q, k, v, 256, 128)),
+            np.asarray(ref), rtol=1e-6, atol=1e-7)
+
+    def test_gqa_supported_bound(self):
+        """The dkv VMEM guard: group*t*d over 2M elements says no."""
+        from tiny_deepspeed_tpu.ops.flash_fa2 import fa2_gqa_supported
+        assert fa2_gqa_supported(2048, 64, 4)        # llama-1b shape
+        assert fa2_gqa_supported(16384, 64, 1)       # == FA2_MAX_T
+        assert not fa2_gqa_supported(16384, 64, 4)   # panels over budget
+
     def test_lse_residual_shape(self):
         """The whole point: the stashed stat is ONE (B*H, 1, T) f32 tensor."""
         q, k, v = (_rand((2, 3, 256, 64), i) for i in range(3))

@@ -14,8 +14,10 @@ TPU-first notes:
   * RoPE is computed in float32 and applied to q/k only; positions are
     GLOBAL indices — under seq x pipe (both axes manual in the pipeline
     region) the local shard offsets by axis_index(seq) * T_local.
-  * GQA: n_kv_head <= n_head; K/V heads jnp.repeat to the query head count
-    before the flash kernel (the repeat is free under GSPMD head sharding).
+  * GQA: n_kv_head <= n_head; K/V enter attention at kv_heads — the FA2
+    kernel consumes them grouped (ops/flash_fa2.py indexes kv panels by
+    query_head // group), so K/V HBM traffic stays at kv_heads; non-flash
+    paths expand in ops/attention.py (free under GSPMD head sharding).
   * SwiGLU hidden defaults to the Llama convention round(8/3 * d) padded up
     to a multiple of 128 so the MXU tiles cleanly.
 """
@@ -183,11 +185,10 @@ class LlamaModel(GPT2Model):
         q = rope(q, pos, c.rope_theta)
         k = rope(k, pos, c.rope_theta)
         kv = (k, v)  # cached UNREPEATED (post-rope): decode groups q heads
-        if nkv != nq:  # GQA: repeat K/V heads up to the query head count
-            rep = nq // nkv
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-
+        # GQA: K/V go in at nkv heads — sharded_attention keeps them
+        # grouped into the FA2 kernel on the flash paths (K/V HBM traffic
+        # stays at kv_heads) and expands only where a path needs equal
+        # head counts (ops/attention.py)
         y = sharded_attention(q, k, v, c.attn_impl, pctx)
         y = y.swapaxes(1, 2).reshape(b, t, d)
         y = linear(y, self._bw(bp, "attn.o.w", pctx), None)

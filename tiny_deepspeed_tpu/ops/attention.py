@@ -24,6 +24,7 @@ Both take (B, H, T, Dh) tensors, matching the reference's post-split layout
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -86,8 +87,30 @@ def flash_attention(q, k, v):
     return _sdpa_or_standard(q, k, v)
 
 
+def gqa_flash_attention(q, k, v):
+    """Grouped-query flash attention: q (B, H, T, Dh), k/v (B, KVH, T, Dh).
+
+    On TPU, within the FA2 kernel's VMEM bound, K/V stay at KVH heads all
+    the way into the kernel (ops/flash_fa2.py indexes kv panels by
+    query_head // group) — the K/V HBM-traffic saving GQA exists for,
+    which the reference's SDPA call gets from cuDNN (ref
+    example/model.py:44-51) and a jnp.repeat forfeits.  Outside the
+    bound, or off-TPU, falls back to repeat + the normal dispatch.  Not
+    autotuned: the GQA site has one kernel candidate."""
+    group = q.shape[1] // k.shape[1]
+    t, d = q.shape[2], q.shape[3]
+    if kernel_target() == "tpu":
+        from .flash_fa2 import fa2_flash_attention, fa2_gqa_supported
+        if fa2_gqa_supported(t, d, group):
+            return fa2_flash_attention(q, k, v)
+    k = jnp.repeat(k, group, axis=1)
+    v = jnp.repeat(v, group, axis=1)
+    return flash_attention(q, k, v)
+
+
 def sharded_attention(q, k, v, impl: str, pctx=None):
-    """Mesh-aware attention dispatch on (B, H, T, Dh) tensors.
+    """Mesh-aware attention dispatch on (B, H, T, Dh) tensors; k/v may
+    carry fewer (grouped-query) heads — (B, KVH, T, Dh) with KVH | H.
 
     * no mesh / 1 device       -> plain `flash_attention`/`standard_attention`
     * sequence-parallel mesh   -> ring attention over the "seq" axis
@@ -104,7 +127,29 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
     # cannot be auto-partitioned over the remaining GSPMD axes
     local_fn = (_sdpa_or_standard if impl == "flash_attention"
                 else standard_attention)
+
+    # GQA: k/v arrive at KVH <= H heads (llama.py passes them UNREPEATED).
+    # The flash paths below keep them grouped all the way into the FA2
+    # kernel; every other path expands here — under GSPMD head sharding
+    # the repeat is free, which is exactly what it replaced in llama.py.
+    # TINY_DS_GQA=repeat is the chip A/B knob (tpu_batch.sh): it forces
+    # the round-4 repeat-then-MHA-kernel path so the GQA-native win is
+    # measured against the exact program it replaced.
+    rep = q.shape[1] // k.shape[1]
+    if rep > 1 and os.environ.get("TINY_DS_GQA") == "repeat":
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+        rep = 1
+
+    def _expand(k, v):
+        if rep == 1:
+            return k, v
+        return jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1)
+
     if pctx is None or not pctx.is_multi_device:
+        if rep > 1 and impl == "flash_attention":
+            return gqa_flash_attention(q, k, v)
+        k, v = _expand(k, v)
         return base_fn(q, k, v)
 
     from ..parallel.ring_attention import ring_attention
@@ -116,6 +161,11 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
     head_axis = pctx.model_axis if pctx.tensor_parallel else None
 
     if pctx.seq_parallel:
+        # ring rotates K/V blocks and ulysses all-to-alls heads<->seq;
+        # both assume matching head counts — expand first (the repeat is
+        # sharded over the head/model axes, so it moves no extra bytes
+        # across the mesh)
+        k, v = _expand(k, v)
         ulysses = getattr(pctx, "seq_impl", "ring") == "ulysses"
         if pctx.pipe_parallel:
             # inside the pipeline's shard_map, which is manual over BOTH
@@ -156,6 +206,7 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
         # shard_map (the Pallas flash path below) would re-manualize the
         # already-manual pipe axis and fail at trace time; use the GSPMD
         # jnp path, which auto-partitions over the remaining axes.
+        k, v = _expand(k, v)
         if head_axis is not None:
             sh = NamedSharding(
                 pctx.mesh, P(pctx.data_axis, head_axis, None, None)
@@ -166,12 +217,17 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
         return local_fn(q, k, v)
 
     if impl == "flash_attention" and kernel_target() == "tpu":
+        # GQA rides through: per-shard head counts keep the same group
+        # ratio (tp must divide kv_heads — models/llama.py tp_rules), so
+        # the local gqa path sees a consistent (H/tp, KVH/tp) pair
         spec = P(pctx.data_axis, head_axis, None, None)
+        local = gqa_flash_attention if rep > 1 else _tuned_pallas_flash
         return jax.shard_map(
-            _tuned_pallas_flash, mesh=pctx.mesh,
+            local, mesh=pctx.mesh,
             in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
         )(q, k, v)
 
+    k, v = _expand(k, v)
     if head_axis is not None:
         # pin the head-sharded layout so GSPMD partitions the attention
         # einsums over heads instead of gathering them

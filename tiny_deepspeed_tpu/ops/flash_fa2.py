@@ -66,7 +66,7 @@ def _causal_mask(s, iq, jk, bq, bk):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
-                *, scale, bq, bk):
+                *, scale, bq, bk, causal=True):
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
 
@@ -74,9 +74,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
 
     # k-blocks [0, nfull) lie entirely below the diagonal (no mask);
     # [nfull, ndiag) straddle it (iota mask); ndiag is one past the last
-    # block any row of this q-block may see.
-    nfull = iq * bq // bk
-    ndiag = pl.cdiv((iq + 1) * bq, bk)
+    # block any row of this q-block may see.  causal=False (a ring
+    # attention off-diagonal chunk: every key is strictly behind every
+    # local query) visits ALL k-blocks unmasked.
+    if causal:
+        nfull = iq * bq // bk
+        ndiag = pl.cdiv((iq + 1) * bq, bk)
+    else:
+        nfull = ndiag = k_ref.shape[1] // bk
 
     def step(jk, m, l, masked):
         k = k_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
@@ -106,22 +111,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
     lse_ref[0, 0] = m + jnp.log(l)
 
 
-def _specs(*, t, d, size):
+def _specs(*, t, d, size, group=1):
     """BlockSpec for one (bh, t, d) q/k/v/o/grad panel operand: block
-    (1, size, d); `size` None means the full-T panel (index pinned 0)."""
+    (1, size, d); `size` None means the full-T panel (index pinned 0).
+    `group` > 1 (GQA) maps the grid's per-QUERY-head index onto the
+    operand's KV-head panels: query head b reads kv panel b // group
+    (query heads of one group are adjacent — llama.py packs them so)."""
     if size is None:
-        return pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0))
-    return pl.BlockSpec((1, size, d), lambda b, i: (b, i, 0))
+        return pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0))
+    return pl.BlockSpec((1, size, d), lambda b, i: (b // group, i, 0))
 
 
-def _fwd(q, k, v, *, scale, bq, bk):
+def _fwd(q, k, v, *, scale, bq, bk, group=1, causal=True):
     bh, t, d = q.shape
     oshape = (bh, t, d)
     sp = functools.partial(_specs, t=t, d=d)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk),
+        functools.partial(_fwd_kernel, scale=scale, bq=bq, bk=bk,
+                          causal=causal),
         grid=(bh, t // bq),
-        in_specs=[sp(size=bq), sp(size=None), sp(size=None)],
+        in_specs=[sp(size=bq),
+                  sp(size=None, group=group), sp(size=None, group=group)],
         out_specs=[
             sp(size=bq),
             pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
@@ -143,7 +153,14 @@ def _fwd(q, k, v, *, scale, bq, bk):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, bq, bk):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, bq, bk,
+                    group=1, causal=True):
+    """Grid is (n_KV_heads * B, t // bk); with GQA (group > 1) the q/do/
+    lse/di blocks carry this kv head's `group` adjacent query heads in
+    their leading dim, statically looped — dk/dv accumulate the sum over
+    the group, which IS d(k)/d(v) under grouped-query sharing.
+    causal=False (ring off-diagonal chunk): every q-block touches this
+    k-block, none masked."""
     jk = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)   # (bk, d)
     v = v_ref[0].astype(jnp.float32)
@@ -151,43 +168,47 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
     dk_acc[:] = jnp.zeros_like(dk_acc)
     dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    nq = pl.num_programs(1) * bk // bq  # q-blocks total (t // bq)
-    first = jk * bk // bq               # first q-block touching this k-block
-    idiag_end = pl.cdiv((jk + 1) * bk, bq)  # first FULLY-unmasked q-block
+    nq = q_ref.shape[1] // bq           # q-blocks total (t // bq)
+    if causal:
+        first = jk * bk // bq           # first q-block touching this k-block
+        idiag_end = pl.cdiv((jk + 1) * bk, bq)  # first FULLY-unmasked q-blk
+    else:
+        first = idiag_end = 0
 
-    def body(iq, masked):
-        q = q_ref[0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(iq * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(iq * bq, bq)]
-        di = di_ref[0, 0, pl.ds(iq * bq, bq)]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if masked:
-            s = _causal_mask(s, iq, jk, bq, bk)
-        p = jnp.exp(s - lse[:, None])                    # (bq, bk)
-        dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # (bq, bk)
-        ds = p * (dp - di[:, None]) * scale
-        dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return 0
+    for g in range(group):  # static unroll over the query heads sharing k/v
+        def body(iq, masked):
+            q = q_ref[g, pl.ds(iq * bq, bq), :].astype(jnp.float32)
+            do = do_ref[g, pl.ds(iq * bq, bq), :].astype(jnp.float32)
+            lse = lse_ref[g, 0, pl.ds(iq * bq, bq)]
+            di = di_ref[g, 0, pl.ds(iq * bq, bq)]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if masked:
+                s = _causal_mask(s, iq, jk, bq, bk)
+            p = jnp.exp(s - lse[:, None])                    # (bq, bk)
+            dv_acc[:] += jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # (bq, bk)
+            ds = p * (dp - di[:, None]) * scale
+            dk_acc[:] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return 0
 
-    jax.lax.fori_loop(first, idiag_end,
-                      lambda i, c: body(i, masked=True), 0)
-    jax.lax.fori_loop(idiag_end, nq,
-                      lambda i, c: body(i, masked=False), 0)
+        jax.lax.fori_loop(first, idiag_end,
+                          lambda i, c: body(i, masked=True), 0)
+        jax.lax.fori_loop(idiag_end, nq,
+                          lambda i, c: body(i, masked=False), 0)
     dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
     dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
-                   dq_ref, dq_acc, *, scale, bq, bk):
+                   dq_ref, dq_acc, *, scale, bq, bk, causal=True):
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -195,8 +216,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
     di = di_ref[0, 0]
 
     dq_acc[:] = jnp.zeros_like(dq_acc)
-    nfull = iq * bq // bk
-    ndiag = pl.cdiv((iq + 1) * bq, bk)
+    if causal:
+        nfull = iq * bq // bk
+        ndiag = pl.cdiv((iq + 1) * bq, bk)
+    else:
+        nfull = ndiag = k_ref.shape[1] // bk
 
     def body(jk, masked):
         k = k_ref[0, pl.ds(jk * bk, bk), :].astype(jnp.float32)
@@ -221,32 +245,30 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
     dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd(res, g, *, scale, bq, bk):
-    q, k, v, o, lse = res
+def _dkv_call(q, k, v, do, lse, di, *, scale, bq, bk, group=1, causal=True):
+    """dk/dv pass: grid walks KV-head panels of k; q/do/lse/di blocks
+    carry the whole query-head group in their leading dim (block index j
+    on a group-leading block addresses rows [j*group, (j+1)*group) —
+    exactly kv panel j's query heads)."""
     bh, t, d = q.shape
-    pshape = (bh, t, d)
-    do = g
-    # di = rowsum(do * o): one fused elementwise+reduce in XLA, (bh, 1, t)
-    # f32 — consumed directly by both kernels, never broadcast to block
-    # width
-    di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                 axis=-1)[:, None, :]
+    bkvh = k.shape[0]  # bh // group KV-head panels under GQA
     sp = functools.partial(_specs, t=t, d=d)
-
-    stat_full = pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0))
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk),
-        grid=(bh, t // bk),
-        in_specs=[sp(size=None),   # q (full)
+    gq_full = pl.BlockSpec((group, t, d), lambda j, i: (j, 0, 0))
+    stat_full = pl.BlockSpec((group, 1, t), lambda b, j: (b, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, bq=bq, bk=bk,
+                          group=group, causal=causal),
+        grid=(bkvh, t // bk),
+        in_specs=[gq_full,         # q (full, whole group)
                   sp(size=bk),     # k (block)
                   sp(size=bk),     # v (block)
-                  sp(size=None),   # do (full)
-                  stat_full,             # lse (full)
-                  stat_full],            # di (full)
+                  gq_full,         # do (full, whole group)
+                  stat_full,             # lse (full, whole group)
+                  stat_full],            # di (full, whole group)
         out_specs=[sp(size=bk), sp(size=bk)],
         out_shape=[
-            jax.ShapeDtypeStruct(pshape, k.dtype),
-            jax.ShapeDtypeStruct(pshape, v.dtype),
+            jax.ShapeDtypeStruct((bkvh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bkvh, t, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -255,22 +277,78 @@ def _bwd(res, g, *, scale, bq, bk):
         interpret=_INTERPRET,
     )(q, k, v, do, lse, di)
 
+
+def _dq_call(q, k, v, do, lse, di, *, scale, bq, bk, group=1, causal=True):
+    bh, t, d = q.shape
+    sp = functools.partial(_specs, t=t, d=d)
     stat_blk = pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i))
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk),
+    return pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, bq=bq, bk=bk,
+                          causal=causal),
         grid=(bh, t // bq),
         in_specs=[sp(size=bq),     # q (block)
-                  sp(size=None),   # k (full)
-                  sp(size=None),   # v (full)
+                  sp(size=None, group=group),   # k (full, kv-indexed)
+                  sp(size=None, group=group),   # v (full, kv-indexed)
                   sp(size=bq),     # do (block)
                   stat_blk,              # lse (block)
                   stat_blk],             # di (block)
         out_specs=sp(size=bq),
-        out_shape=jax.ShapeDtypeStruct(pshape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_INTERPRET,
     )(q, k, v, do, lse, di)
+
+
+def _bwd(res, g, *, scale, bq, bk, group=1):
+    q, k, v, o, lse = res
+    do = g
+    # di = rowsum(do * o): one fused elementwise+reduce in XLA, (bh, 1, t)
+    # f32 — consumed directly by both kernels, never broadcast to block
+    # width
+    di = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                 axis=-1)[:, None, :]
+    dk, dv = _dkv_call(q, k, v, do, lse, di, scale=scale, bq=bq, bk=bk,
+                       group=group)
+    dq = _dq_call(q, k, v, do, lse, di, scale=scale, bq=bq, bk=bk,
+                  group=group)
     return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# chunk-level raw entries for ring attention (parallel/ring_attention.py)
+#
+# The ring's per-device step is chunk-local attention between the resident
+# q block and a rotating K/V chunk: the DIAGONAL chunk (global offsets
+# equal) is ordinary causal attention, every other contributing chunk is
+# FULLY unmasked (all its keys are strictly behind all local queries).
+# These entries expose the same kernels with a static `causal` switch and
+# hand back the raw (o, lse) pair / consume the global (lse, di) stats the
+# ring's custom_vjp merges across chunks — no custom_vjp of their own.
+# ---------------------------------------------------------------------------
+
+
+def fa2_chunk_fwd(q, k, v, *, causal: bool, block: int = 512):
+    """(BH, T, D) panels -> (o normalized within the chunk, lse (BH,1,T))."""
+    bh, t, d = q.shape
+    bq, bk = _pick(t, block), _pick(t, block)
+    return _fwd(q, k, v, scale=1.0 / math.sqrt(d), bq=bq, bk=bk,
+                causal=causal)
+
+
+def fa2_chunk_dq(q, k, v, do, lse, di, *, causal: bool, block: int = 512):
+    """dq of one chunk given the GLOBAL (merged) lse and di stats."""
+    bh, t, d = q.shape
+    bq, bk = _pick(t, block), _pick(t, block)
+    return _dq_call(q, k, v, do, lse, di, scale=1.0 / math.sqrt(d),
+                    bq=bq, bk=bk, causal=causal)
+
+
+def fa2_chunk_dkv(q, k, v, do, lse, di, *, causal: bool, block: int = 512):
+    """(dk, dv) of one chunk given the GLOBAL (merged) lse and di stats."""
+    bh, t, d = q.shape
+    bq, bk = _pick(t, block), _pick(t, block)
+    return _dkv_call(q, k, v, do, lse, di, scale=1.0 / math.sqrt(d),
+                     bq=bq, bk=bk, causal=causal)
 
 
 # ---------------------------------------------------------------------------
@@ -280,19 +358,46 @@ def _bwd(res, g, *, scale, bq, bk):
 _INTERPRET = False  # tests flip this on CPU (no Mosaic backend there)
 
 
+# GQA VMEM bound: the dkv pass holds the kv head's whole query-head
+# group of Q and dO panels VMEM-resident — group * t * d elements each
+# (bf16).  2M elements = 4 MB/panel, 8 MB for the pair, inside the
+# ~16 MB/core budget next to the k/v blocks and f32 scratch.  At
+# group=1 this is exactly the FA2_MAX_T=16384 (d=64) bound the
+# dispatch layer already applies.
+_GQA_MAX_PANEL = 2 * 1024 * 1024
+
+
+def fa2_gqa_supported(t: int, d: int, group: int) -> bool:
+    """True when the GQA kernel's dkv VMEM panels fit (trace-time check)."""
+    return group * t * d <= _GQA_MAX_PANEL
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def fa2_flash_attention(q, k, v, block_q: int = 512, block_k: int = 512):
-    """Causal FA2 attention on (B, H, T, Dh); returns (B, H, T, Dh)."""
+    """Causal FA2 attention; returns (B, H, T, Dh).
+
+    q is (B, H, T, Dh); k/v may be (B, KVH, T, Dh) with KVH | H —
+    grouped-query attention runs NATIVELY: K/V stay at KVH heads in HBM
+    and VMEM (the kernels index kv panels by query_head // group), and
+    dk/dv come back at KVH heads (the in-kernel group sum IS the
+    repeat's vjp).  The query heads of one group must be adjacent —
+    the jnp.repeat(k, H//KVH, axis=1) ordering, which is how llama.py
+    lays them out (ref example/model.py:44-51 is the MHA-only
+    counterpart this generalizes)."""
     out, _ = _fa2_fwd(q, k, v, block_q, block_k)
     return out
 
 
 def _fa2_fwd(q, k, v, block_q, block_k):
     b, h, t, d = q.shape
+    kvh = k.shape[1]
+    assert h % kvh == 0, f"query heads {h} not a multiple of kv heads {kvh}"
+    group = h // kvh
     bq, bk = _pick(t, block_q), _pick(t, block_k)
     scale = 1.0 / math.sqrt(d)
-    flat = lambda x: x.reshape(b * h, t, d)
-    o, lse = _fwd(flat(q), flat(k), flat(v), scale=scale, bq=bq, bk=bk)
+    o, lse = _fwd(q.reshape(b * h, t, d),
+                  k.reshape(b * kvh, t, d), v.reshape(b * kvh, t, d),
+                  scale=scale, bq=bq, bk=bk, group=group)
     o = o.reshape(b, h, t, d)
     return o, (q, k, v, o, lse)
 
@@ -300,14 +405,17 @@ def _fa2_fwd(q, k, v, block_q, block_k):
 def _fa2_bwd(block_q, block_k, res, g):
     q, k, v, o, lse = res
     b, h, t, d = q.shape
+    kvh = k.shape[1]
+    group = h // kvh
     bq, bk = _pick(t, block_q), _pick(t, block_k)
     scale = 1.0 / math.sqrt(d)
     flat = lambda x: x.reshape(b * h, t, d)
     dq, dk, dv = _bwd(
-        (flat(q), flat(k), flat(v), flat(o), lse), flat(g),
-        scale=scale, bq=bq, bk=bk)
-    unflat = lambda x: x.reshape(b, h, t, d)
-    return unflat(dq), unflat(dk), unflat(dv)
+        (flat(q), k.reshape(b * kvh, t, d), v.reshape(b * kvh, t, d),
+         flat(o), lse), flat(g),
+        scale=scale, bq=bq, bk=bk, group=group)
+    return (dq.reshape(b, h, t, d),
+            dk.reshape(b, kvh, t, d), dv.reshape(b, kvh, t, d))
 
 
 fa2_flash_attention.defvjp(_fa2_fwd, _fa2_bwd)
