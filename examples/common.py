@@ -97,10 +97,11 @@ def parse_args(default_model="gpt2-124m", **defaults):
     )
     p.add_argument(
         "--gather-quant", choices=("fp8",), default=None,
-        help="ZeRO++-style quantized weight gather (EXPERIMENTAL): block "
-             "weights stack as float8_e4m3 + per-channel scales so the "
-             "ZeRO-3 per-layer gather can move sub-f32 values (backend-"
-             "dependent; models/gpt2.py gather_quant docstring)",
+        help="ZeRO++-style quantized weight gather: block weights stack "
+             "as float8_e4m3 + stop-gradiented per-channel scales so the "
+             "ZeRO-3 per-layer gathers move f8 bytes (TPU HLO: net -23%% "
+             "wire vs unquantized, PROFILE.md finding 5; lossy — the CPU "
+             "backend upcasts and gains nothing)",
     )
     def _loss_scale(v):
         if v == "dynamic":

@@ -488,7 +488,14 @@ class GPT2Model:
 
         With config.gather_quant="fp8", eligible weights become
         float8_e4m3 + a per-output-channel f32 scale (key + "#scale") —
-        consumed through `_bw`, which dequantizes after the gather."""
+        consumed through `_bw`, which dequantizes after the gather.  The
+        scale is STOP-GRADIENTED (straight-through estimator): the exact
+        vjp of the absmax/quotient round trip is quantization-sawtooth
+        noise, and carrying it cost ~4.6 MB/step of scale-cotangent
+        all-reduce on the TPU-partitioned HLO (round-5 measurement,
+        PROFILE.md finding 5) — with STE the weight cotangent passes
+        straight through the dequant multiply and the scale moves no
+        backward bytes."""
         cd = self.config.compute_dtype
         out = {}
         for k, v in params.items():
@@ -501,6 +508,7 @@ class GPT2Model:
                     jnp.abs(v.astype(jnp.float32)),
                     axis=tuple(range(1, v.ndim - 1)), keepdims=True,
                 ) / 448.0 + 1e-12
+                s = jax.lax.stop_gradient(s)
                 out[name] = (v / s).astype(jnp.float8_e4m3fn)
                 out[name + "#scale"] = s.astype(jnp.float32)
             else:

@@ -98,7 +98,8 @@ except Exception:  # pragma: no cover - flax always present in this image
 # ---------------------------------------------------------------------------
 
 def _leaf_spec(name: str, shape, n_dev: int, axis: str = "data",
-               reserved: Optional[Dict[int, str]] = None) -> P:
+               reserved: Optional[Dict[int, str]] = None,
+               prefer_dim: Optional[int] = None) -> P:
     """Even axis-sharding rule for one tensor.
 
     `reserved` pre-places mesh axes on specific dims (tensor/expert
@@ -108,6 +109,14 @@ def _leaf_spec(name: str, shape, n_dev: int, axis: str = "data",
     it, and keeping it unsharded is what makes XLA's all-gather happen
     per-layer *inside* the loop (the ZeRO-3 gather-on-demand).  Indivisible /
     small tensors replicate.
+
+    `prefer_dim` overrides the largest-axis walk when that dim is free and
+    divisible.  Used by the fp8 gather (engine passes the IN dim for
+    quant-eligible leaves): an OUT-dim shard is exactly aligned with the
+    per-out-channel dequant scale, so the SPMD partitioner dequantizes
+    shard-side for free and all-gathers bf16 — the f8 wire saving only
+    exists when the shard axis and the scale axis differ (round-5
+    TPU-HLO measurement, PROFILE.md finding 5).
     """
     if not shape:
         return P()
@@ -115,12 +124,18 @@ def _leaf_spec(name: str, shape, n_dev: int, axis: str = "data",
     for dim, ax in (reserved or {}).items():
         spec[dim] = ax
     if n_dev > 1:
-        start = 1 if name.startswith("h.") and len(shape) > 1 else 0
         best = None
-        for ax in range(start, len(shape)):
-            if spec[ax] is None and shape[ax] % n_dev == 0 and shape[ax] >= n_dev:
-                if best is None or shape[ax] > shape[best]:
-                    best = ax
+        if (prefer_dim is not None and spec[prefer_dim] is None
+                and shape[prefer_dim] % n_dev == 0
+                and shape[prefer_dim] >= n_dev):
+            best = prefer_dim
+        else:
+            start = 1 if name.startswith("h.") and len(shape) > 1 else 0
+            for ax in range(start, len(shape)):
+                if spec[ax] is None and shape[ax] % n_dev == 0 \
+                        and shape[ax] >= n_dev:
+                    if best is None or shape[ax] > shape[best]:
+                        best = ax
         if best is not None:
             spec[best] = axis
     while spec and spec[-1] is None:  # P(None, ...) normalizes to P()
@@ -131,10 +146,13 @@ def _leaf_spec(name: str, shape, n_dev: int, axis: str = "data",
 def _param_spec_tree(
     shapes: Dict[str, Any], n_dev: int,
     reserved: Optional[Dict[str, Dict[int, str]]] = None,
+    prefer_dims: Optional[Dict[str, int]] = None,
 ) -> Dict[str, P]:
     reserved = reserved or {}
+    prefer_dims = prefer_dims or {}
     return {
-        n: _leaf_spec(n, s.shape, n_dev, reserved=reserved.get(n))
+        n: _leaf_spec(n, s.shape, n_dev, reserved=reserved.get(n),
+                      prefer_dim=prefer_dims.get(n))
         for n, s in shapes.items()
     }
 
@@ -439,7 +457,21 @@ class ZeroEngine:
                     )
                 reserved.setdefault(name, {})[0] = self.pipe_axis
 
-        specs = _param_spec_tree(shapes, self.n_shard, reserved)
+        # fp8 gather: pin quant-eligible leaves' ZeRO shard to the IN dim
+        # (dim 1 of the stacked (L, in, out)) so the shard axis differs
+        # from the per-out-channel scale axis and the per-layer gathers
+        # move f8 bytes (see _leaf_spec prefer_dim).  Under TP, o/down
+        # reserve dim 1 for the model axis — those fall back to the walk.
+        prefer_dims = {}
+        if getattr(getattr(model, "config", None), "gather_quant", None) \
+                and hasattr(model, "_quant_eligible"):
+            prefer_dims = {
+                n: 1 for n, s in shapes.items()
+                if n.startswith("h.")
+                and model._quant_eligible(n[len("h."):], s)
+            }
+        specs = _param_spec_tree(shapes, self.n_shard, reserved,
+                                 prefer_dims=prefer_dims)
         self._shard_spec = specs  # even-shard spec per param
         self._shard_shardings = _to_shardings(specs, mesh)
         # base spec: tensor/expert placements only (no ZeRO data shard)
