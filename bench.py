@@ -399,17 +399,6 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     model = build_model(cfg)
     devices = jax.devices()
     n_chips = len(devices)
-    # Effective MoE dispatch: the sort knob is inert on multi-device meshes
-    # (moe.py falls back to einsum whenever pctx.is_multi_device, which for
-    # the bench mesh — make_mesh over all devices — is n_chips > 1).  One
-    # predicate feeds both the warning and the record so they can't drift.
-    moe_eff = None
-    if hasattr(cfg, "moe_dispatch"):
-        moe_eff = "einsum" if n_chips > 1 else cfg.moe_dispatch
-        if moe_eff != cfg.moe_dispatch:
-            print(f"bench: moe_dispatch={cfg.moe_dispatch!r} is INERT on a "
-                  f"multi-device mesh; the measurement below is the "
-                  f"{moe_eff} path", file=sys.stderr)
     mesh = make_mesh()
     opt = AdamW(lr=1e-5, weight_decay=0.1,
                 state_dtype=bc["state_dtype"] or jnp.float32)
@@ -422,6 +411,17 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         from tiny_deepspeed_tpu import Zero2
         engine = Zero2(model, opt, mesh=mesh, **ek)
         b *= n_chips
+    # Effective MoE dispatch: moe.py's ONE fallback predicate, so the
+    # record can never claim a knob value that fell back (sort runs
+    # shard-local under pure DP since round 5; einsum under ep/tp/sp/pipe)
+    moe_eff = None
+    if hasattr(cfg, "moe_dispatch"):
+        from tiny_deepspeed_tpu.models.moe import effective_dispatch
+        moe_eff = effective_dispatch(cfg, engine.pctx)
+        if moe_eff != cfg.moe_dispatch:
+            print(f"bench: moe_dispatch={cfg.moe_dispatch!r} is INERT on "
+                  f"this mesh; the measurement below is the {moe_eff} path",
+                  file=sys.stderr)
 
     state = engine.init(jax.random.PRNGKey(0))
     # Compile-OOM guard: the memory envelope moves with the XLA version

@@ -135,17 +135,45 @@ class TestSortDispatch:
         losses, _ = run_steps(eng, n=4)
         assert all(np.isfinite(losses))
 
-    @pytest.mark.parametrize("ep", [2, 1])
-    def test_multi_device_falls_back_to_einsum(self, ep):
-        """On ANY multi-device mesh the sort knob is inert — under EP the
-        einsum contraction is the all-to-all boundary, and under plain DP
-        a global argsort over the sharded token axis would force
-        cross-device gathers — so the loss must match einsum exactly."""
+    def test_ep_falls_back_to_einsum(self):
+        """Under expert parallelism the sort knob is inert — the einsum
+        contraction IS the all-to-all boundary — so the loss must match
+        einsum exactly."""
         import dataclasses
         from tiny_deepspeed_tpu import Zero1
         cfg_s = dataclasses.replace(CFG, moe_dispatch="sort")
-        e1 = Zero1(MoEGPT(CFG), AdamW(lr=1e-3), expert_parallel=ep)
-        e2 = Zero1(MoEGPT(cfg_s), AdamW(lr=1e-3), expert_parallel=ep)
+        e1 = Zero1(MoEGPT(CFG), AdamW(lr=1e-3), expert_parallel=2)
+        e2 = Zero1(MoEGPT(cfg_s), AdamW(lr=1e-3), expert_parallel=2)
         (l1, *_), _ = run_steps(e1, n=1)
         (l2, *_), _ = run_steps(e2, n=1)
         assert abs(l1 - l2) < 1e-5
+
+    def test_pure_dp_runs_shard_local_sort(self):
+        """Round 5: under pure data parallelism sort dispatch runs
+        SHARD-LOCAL (experts replicated, each device argsorts its own
+        token shard) — with ample capacity nothing drops on either path,
+        so sort and einsum must agree to float tolerance, and the
+        effective_dispatch predicate must say so."""
+        import dataclasses
+        from tiny_deepspeed_tpu import Zero1
+        from tiny_deepspeed_tpu.models.moe import effective_dispatch
+        roomy = dataclasses.replace(CFG, capacity_factor=4.0)
+        cfg_s = dataclasses.replace(roomy, moe_dispatch="sort")
+        e1 = Zero1(MoEGPT(roomy), AdamW(lr=1e-3))
+        e2 = Zero1(MoEGPT(cfg_s), AdamW(lr=1e-3))
+        assert effective_dispatch(cfg_s, e2.pctx) == "sort"
+        (l1, *_), _ = run_steps(e1, n=1)
+        (l2, *_), _ = run_steps(e2, n=1)
+        assert abs(l1 - l2) < 1e-4, (l1, l2)
+
+    def test_effective_dispatch_predicate(self):
+        """The single fallback predicate bench.py records: sort survives
+        single-device and pure DP, falls back under ep/tp/sp/pipe."""
+        import dataclasses
+        from tiny_deepspeed_tpu import Zero1
+        from tiny_deepspeed_tpu.models.moe import effective_dispatch
+        cfg_s = dataclasses.replace(CFG, moe_dispatch="sort")
+        assert effective_dispatch(cfg_s, None) == "sort"
+        assert effective_dispatch(CFG, None) == "einsum"
+        ep_eng = Zero1(MoEGPT(cfg_s), AdamW(lr=1e-3), expert_parallel=2)
+        assert effective_dispatch(cfg_s, ep_eng.pctx) == "einsum"
