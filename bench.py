@@ -61,6 +61,7 @@ def _config_fingerprint(env=None) -> str:
         "batch": env.get("BENCH_BATCH", ""),
         "seq": env.get("BENCH_SEQ", "1024"),
         "offload": env.get("BENCH_OFFLOAD", ""),
+        "offload_prefetch": env.get("BENCH_OFFLOAD_PREFETCH", ""),
         "autotune": env.get("BENCH_AUTOTUNE", ""),
         "decode": env.get("BENCH_DECODE", ""),
         "moe_dispatch": env.get("BENCH_MOE_DISPATCH", ""),
@@ -405,6 +406,9 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     ek = {}
     if os.environ.get("BENCH_OFFLOAD"):
         ek["offload_opt_state"] = True  # moments to pinned_host (TPU only)
+        if os.environ.get("BENCH_OFFLOAD_PREFETCH"):
+            # round-5 A/B knob: in-flight window of streamed moment leaves
+            ek["offload_prefetch"] = int(os.environ["BENCH_OFFLOAD_PREFETCH"])
     if n_chips == 1:
         engine = SingleDevice(model, opt, mesh=mesh, **ek)
     else:

@@ -44,6 +44,12 @@ def bucket_for(name: str, d_model: int, vocab: int) -> str:
         return "QKV fusions"
     if "dynamic-update-slice" in name or "dynamic-slice" in name:
         return "scan stash/slices"
+    if "copy-start" in head or "copy-done" in head:
+        # the offload stream's async host<->HBM transfers (and any other
+        # async copies) — the bucket VERDICT r4 #5 asked for: on an
+        # offload_opt_state run this is the moments traffic, and its
+        # size vs the update/other buckets says what the streaming hides
+        return "async copies (offload stream)"
     if "copy" in head:
         return "copies"
     return "other"
@@ -53,6 +59,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt2-124m")
     ap.add_argument("--out", default="/tmp/profile_step")
+    ap.add_argument("--offload", action="store_true",
+                    help="profile the offload_opt_state step (adds the "
+                         "async-copy bucket attribution for the moments "
+                         "stream)")
+    ap.add_argument("--offload-prefetch", type=int, default=4)
     args = ap.parse_args()
 
     import jax
@@ -67,7 +78,11 @@ def main():
     model = build_model(cfg)
     opt = AdamW(lr=1e-5, weight_decay=0.1,
                 state_dtype=bc["state_dtype"] or jnp.float32)
-    engine = SingleDevice(model, opt, mesh=make_mesh())
+    ek = {}
+    if args.offload:
+        ek = dict(offload_opt_state=True,
+                  offload_prefetch=args.offload_prefetch)
+    engine = SingleDevice(model, opt, mesh=make_mesh(), **ek)
     state = engine.init(jax.random.PRNGKey(0))
     b, t = bc["batch"], 1024
     idx = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0,
@@ -99,7 +114,8 @@ def main():
         if bk != "SKIP":
             tot[bk] += e.duration_ns / 1e6 / STEPS
     print(json.dumps({
-        "model": args.model, "batch": b, "xplane": xplane,
+        "model": args.model, "batch": b, "offload": bool(args.offload),
+        "xplane": xplane,
         "step_ms": round(sum(tot.values()), 2),
         "buckets_ms": {k: round(v, 2) for k, v in
                        sorted(tot.items(), key=lambda x: -x[1])},

@@ -63,9 +63,21 @@ log "8. offload execution test (TPU-gated)"
 timeout 1200 python -m pytest tests/test_offload.py -q > "$OUT/offload.log" 2>&1
 log "   rc=$? $(tail -1 "$OUT/offload.log")"
 
-log "9. offload bench (1.5b HBM delta)"
+log "9. offload bench (1.5b HBM delta; round-5 default prefetch window 4)"
 timeout 2400 env BENCH_OFFLOAD=1 BENCH_MODEL=gpt2-1.5b python bench.py > "$OUT/bench_offload.json" 2> "$OUT/bench_offload.err"
 log "   rc=$? $(cat "$OUT/bench_offload.json" 2>/dev/null | head -c 200)"
+
+log "9b. offload prefetch-window A/B at 774M (w=4 at 1.5B compiles OVER-CHIP"
+log "    — 17.25 GB, round-5 AOT study — so the window A/B runs where"
+log "    there is headroom)"
+timeout 2400 env BENCH_OFFLOAD=1 BENCH_OFFLOAD_PREFETCH=2 BENCH_MODEL=gpt2-774m python bench.py > "$OUT/bench_offload_w2.json" 2> "$OUT/bench_offload_w2.err"
+log "   774m w=2 rc=$? $(cat "$OUT/bench_offload_w2.json" 2>/dev/null | head -c 160)"
+timeout 2400 env BENCH_OFFLOAD=1 BENCH_OFFLOAD_PREFETCH=4 BENCH_MODEL=gpt2-774m python bench.py > "$OUT/bench_offload_w4.json" 2> "$OUT/bench_offload_w4.err"
+log "   774m w=4 rc=$? $(cat "$OUT/bench_offload_w4.json" 2>/dev/null | head -c 160)"
+
+log "9c. offload per-op profile (async-copy bucket attribution)"
+timeout 1800 python scripts/profile_step.py --model gpt2-1.5b --offload --out "$OUT/xplane_offload" > "$OUT/profile_offload.json" 2> "$OUT/profile_offload.err"
+log "   rc=$? $(cat "$OUT/profile_offload.json" 2>/dev/null | head -c 300)"
 
 log "10. heads-last FA2 A/B (round-4 experiment, see scripts/fa2_bthd_ab.py)"
 timeout 1200 python scripts/fa2_bthd_ab.py > "$OUT/fa2_bthd_ab.jsonl" 2> "$OUT/fa2_bthd_ab.err"
