@@ -66,6 +66,7 @@ def _config_fingerprint(env=None) -> str:
         "decode": env.get("BENCH_DECODE", ""),
         "moe_dispatch": env.get("BENCH_MOE_DISPATCH", ""),
         "gqa": env.get("TINY_DS_GQA", ""),
+        "xent": env.get("BENCH_XENT", ""),
     }, sort_keys=True)
 
 
@@ -377,6 +378,11 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     if md and hasattr(cfg, "moe_dispatch"):
         # round-4 A/B knob: sort vs einsum dispatch (MoEConfig.moe_dispatch)
         cfg = dataclasses.replace(cfg, moe_dispatch=md)
+    if os.environ.get("BENCH_XENT") == "pallas":
+        # round-5 A/B knob: the Pallas fused lm_head+xent kernel
+        # (ops/xent_pallas.py) vs whatever head the config default runs
+        cfg = dataclasses.replace(cfg, fused_xent=True,
+                                  fused_xent_impl="pallas")
     if t > cfg.block_size:
         # long-context invocation (BENCH_SEQ=4096/8192): widen the position
         # table and drop the short-context speed knobs — remat back on and
@@ -547,6 +553,8 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             "effective": {
                 "remat": str(cfg.remat),
                 "fused_xent": str(cfg.fused_xent),
+                "fused_xent_impl": str(
+                    getattr(cfg, "fused_xent_impl", "chunked")),
                 "scan_unroll": str(cfg.scan_unroll),
             },
             "config": {

@@ -99,4 +99,10 @@ log "12. per-op profile of the default step (scripts/profile_step.py)"
 timeout 1200 python scripts/profile_step.py --out "$OUT/xplane" > "$OUT/profile_buckets.json" 2> "$OUT/profile_buckets.err"
 log "   rc=$? $(cat "$OUT/profile_buckets.json" 2>/dev/null | head -c 300)"
 
+log "13. Pallas fused lm_head+xent A/B (round-5 kernel, ops/xent_pallas.py)"
+for m in gpt2-124m gpt2-1.5b; do
+  timeout 1800 env BENCH_MODEL=$m BENCH_XENT=pallas python bench.py > "$OUT/bench_${m}_xent_pallas.json" 2> "$OUT/bench_${m}_xent_pallas.err"
+  log "   $m pallas-xent rc=$? $(cat "$OUT/bench_${m}_xent_pallas.json" 2>/dev/null | head -c 160)"
+done
+
 log "batch complete; results in $OUT"

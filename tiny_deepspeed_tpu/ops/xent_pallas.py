@@ -140,6 +140,12 @@ def _tile_dz(x_ref, w_ref, t_ref, lse_ref, gs_ref, j, *, bv, v):
     """Recompute one (bs, bv) tile's dz = (softmax - onehot) * g/n."""
     x = x_ref[...].astype(jnp.float32)
     w = w_ref[...].astype(jnp.float32)
+    d = w.shape[0]
+    # zero the vocab-tail overhang IN W, not just in dz: the padded block
+    # columns are uninitialized memory, and 0 * NaN = NaN would poison the
+    # dz @ w^T contraction even though dz is 0 there
+    wcols = jax.lax.broadcasted_iota(jnp.int32, (d, bv), 1) + j * bv
+    w = jnp.where(wcols < v, w, 0.0)
     z = jax.lax.dot_general(
         x, w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -237,11 +243,13 @@ def _bwd(x, w, targets, lse, gscale, *, bs, bv_dx, bv_dw):
 # public entry
 # ---------------------------------------------------------------------------
 
-# vocab-tile widths: fwd/dx tiles hold one (d, bv) weight panel + a
-# (bs, bv) f32 logit tile; the dw pass adds a (d, bv) f32 accumulator, so
-# it runs narrower.  At d=1600 (gpt2-1.5b): fwd ~4.3 MB, dw ~7 MB of the
-# ~16 MB/core VMEM.
+# vocab-tile widths: each pass holds one (d, bv) weight panel (double-
+# buffered by the pipeline) + a (bs, bv) f32 logit tile; dx adds a
+# (bs, d) f32 accumulator and dw a (d, bv) one.  1024-wide dx measured
+# 0.5 MB over the 16 MB scoped-vmem limit at d=1600 (v5e AOT compile),
+# so the backward passes run at 512.
 _BV_FWD = 1024
+_BV_DX = 512
 _BV_DW = 512
 
 
@@ -275,7 +283,7 @@ def _pfx_bwd(res, g):
     s = xf.shape[0]
     bs = _pick_bs(s)
     gscale = (g / s).astype(jnp.float32)
-    dx, dw = _bwd(xf, w, tf, lse, gscale, bs=bs, bv_dx=_BV_FWD,
+    dx, dw = _bwd(xf, w, tf, lse, gscale, bs=bs, bv_dx=_BV_DX,
                   bv_dw=_BV_DW)
     zero = np.zeros(targets.shape, dtype=jax.dtypes.float0)
     return dx.reshape(*lead, d), dw.astype(w.dtype), zero
