@@ -316,30 +316,44 @@ class GPT2Model:
         query's position (cache filled through pos).  Full-length masked
         attention — slots past pos are zero padding, masked out.  GQA
         (Hq > Hkv) groups query heads per KV head instead of materializing
-        a repeated cache."""
+        a repeated cache.
+
+        Decode is HBM-bandwidth bound, so the dots consume the cache in
+        its RESTING dtype with f32 MXU accumulation — the previous
+        `.astype(f32)` on ck/cv materialized two full f32 cache copies
+        per token (~2x the cache bytes; round-5 decode pass).  Scores,
+        mask and softmax stay f32."""
         b, hq, _, dh = q.shape
         hkv = ck.shape[1]
-        qf = q.astype(jnp.float32) * (1.0 / math.sqrt(dh))
-        ckf, cvf = ck.astype(jnp.float32), cv.astype(jnp.float32)
+        scale = 1.0 / math.sqrt(dh)
+        q = q.astype(ck.dtype)
         mask = jnp.arange(ck.shape[2]) <= pos
         if hq != hkv:
             g = hq // hkv
-            att = jnp.einsum("bkgd,bktd->bkgt", qf.reshape(b, hkv, g, dh),
-                             ckf)
+            att = jnp.einsum(
+                "bkgd,bktd->bkgt", q.reshape(b, hkv, g, dh), ck,
+                preferred_element_type=jnp.float32) * scale
             att = jnp.where(mask[None, None, None], att, -jnp.inf)
             att = jax.nn.softmax(att, axis=-1)
-            y = jnp.einsum("bkgt,bktd->bkgd", att, cvf)
+            y = jnp.einsum("bkgt,bktd->bkgd", att.astype(cv.dtype), cv,
+                           preferred_element_type=jnp.float32)
             y = y.reshape(b, hq, 1, dh)
         else:
-            att = jnp.einsum("bhqd,bhtd->bhqt", qf, ckf)
+            att = jnp.einsum("bhqd,bhtd->bhqt", q, ck,
+                             preferred_element_type=jnp.float32) * scale
             att = jnp.where(mask[None, None, None], att, -jnp.inf)
             att = jax.nn.softmax(att, axis=-1)
-            y = jnp.einsum("bhqt,bhtd->bhqd", att, cvf)
+            y = jnp.einsum("bhqt,bhtd->bhqd", att.astype(cv.dtype), cv,
+                           preferred_element_type=jnp.float32)
         return y.astype(q.dtype)
 
-    def _attn_decode(self, x, bp, ck, cv, pos):
-        """Attention half of one decode step: write this position's K/V
-        into the cache, attend, residual-add.  x: (B, 1, D)."""
+    def _attn_decode(self, x, bp, ks, vs, l, pos):
+        """Attention half of one decode step on the STACKED (L, B, Hkv,
+        T, Dh) caches: write this position's K/V — a (1, B, Hkv, 1, Dh)
+        sliver — in place at (l, pos), read layer l's panel, attend,
+        residual-add.  x: (B, 1, D).  The caches ride the layer scan's
+        CARRY (not xs/ys — see _decode_blocks), so the write aliases the
+        buffer instead of restacking it."""
         c = self.config
         b = x.shape[0]
         h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
@@ -349,25 +363,27 @@ class GPT2Model:
         def heads1(z):
             return z.reshape(b, 1, c.n_head, c.head_dim).swapaxes(1, 2)
 
-        ck = jax.lax.dynamic_update_slice(
-            ck, heads1(k).astype(ck.dtype), (0, 0, pos, 0)
+        ks = jax.lax.dynamic_update_slice(
+            ks, heads1(k).astype(ks.dtype)[None], (l, 0, 0, pos, 0)
         )
-        cv = jax.lax.dynamic_update_slice(
-            cv, heads1(v).astype(cv.dtype), (0, 0, pos, 0)
+        vs = jax.lax.dynamic_update_slice(
+            vs, heads1(v).astype(vs.dtype)[None], (l, 0, 0, pos, 0)
         )
+        ck = jax.lax.dynamic_index_in_dim(ks, l, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(vs, l, 0, keepdims=False)
         y = self._decode_attention(heads1(q), ck, cv, pos)
         y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
         y = linear(y, self._bw(bp, "attn.proj.w"), bp.get("attn.proj.b"))
-        return x + y, ck, cv
+        return x + y, ks, vs
 
-    def _block_decode(self, x, bp, ck, cv, pos):
+    def _block_decode(self, x, bp, ks, vs, l, pos):
         """One block, one token: cached attention + MLP."""
-        x, ck, cv = self._attn_decode(x, bp, ck, cv, pos)
+        x, ks, vs = self._attn_decode(x, bp, ks, vs, l, pos)
         h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
         h = linear(h, self._bw(bp, "mlp.fc.w"), bp.get("mlp.fc.b"))
         h = jax.nn.gelu(h, approximate=True)
         h = linear(h, self._bw(bp, "mlp.proj.w"), bp.get("mlp.proj.b"))
-        return x + h, ck, cv
+        return x + h, ks, vs
 
     def _prefill_body(self, x, bp):
         """Scan body for the prompt pass: (x, (k, v)).  Families whose
@@ -388,13 +404,25 @@ class GPT2Model:
         return self.head(params, x)[:, 0], jnp.pad(ks, pad), jnp.pad(vs, pad)
 
     def _decode_blocks(self, stacked, x, ks, vs, pos):
-        def body(x, layer):
-            bp, ck, cv = layer
-            xo, ck, cv = self._block_decode(x, bp, ck, cv, pos)
-            return xo, (ck, cv)
+        """Layer loop for one decode token.  The caches ride the CARRY
+        and each layer writes its (1, B, H, 1, Dh) sliver in place —
+        the previous formulation passed them as scan xs/ys, which
+        restacked (read + wrote) the ENTIRE (L, B, H, T, Dh) cache pair
+        every token (~226 MB/token at the 124M decode bench shape, pure
+        copy; round-5 decode pass)."""
+        n_layer = jax.tree.leaves(stacked)[0].shape[0]
 
-        x, (ks, vs) = jax.lax.scan(body, x, (stacked, ks, vs),
-                                   unroll=self.config.scan_unroll)
+        def body(carry, l):
+            x, ks, vs = carry
+            bp = jax.tree.map(
+                lambda s: jax.lax.dynamic_index_in_dim(
+                    s, l, 0, keepdims=False), stacked)
+            x, ks, vs = self._block_decode(x, bp, ks, vs, l, pos)
+            return (x, ks, vs), None
+
+        (x, ks, vs), _ = jax.lax.scan(
+            body, (x, ks, vs), jnp.arange(n_layer),
+            unroll=self.config.scan_unroll)
         return x, ks, vs
 
     def _embed_decode(self, params, tok, pos):

@@ -208,7 +208,10 @@ class LlamaModel(GPT2Model):
 
     # -- KV-cache decode (GPT2Model machinery; Llama attention/MLP) --------
 
-    def _attn_decode(self, x, bp, ck, cv, pos):
+    def _attn_decode(self, x, bp, ks, vs, l, pos):
+        """Stacked-cache contract (GPT2Model._attn_decode): write this
+        position's K/V sliver in place at (l, pos), read layer l's
+        panel, attend (grouped — the cache rests at kv_heads)."""
         c = self.config
         b = x.shape[0]
         hd = c.head_dim
@@ -222,22 +225,24 @@ class LlamaModel(GPT2Model):
         p1 = jnp.reshape(pos, (1,))
         q = rope(q, p1, c.rope_theta)
         k = rope(k, p1, c.rope_theta)
-        ck = jax.lax.dynamic_update_slice(
-            ck, k.astype(ck.dtype), (0, 0, pos, 0)
+        ks = jax.lax.dynamic_update_slice(
+            ks, k.astype(ks.dtype)[None], (l, 0, 0, pos, 0)
         )
-        cv = jax.lax.dynamic_update_slice(
-            cv, v.astype(cv.dtype), (0, 0, pos, 0)
+        vs = jax.lax.dynamic_update_slice(
+            vs, v.astype(vs.dtype)[None], (l, 0, 0, pos, 0)
         )
+        ck = jax.lax.dynamic_index_in_dim(ks, l, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(vs, l, 0, keepdims=False)
         y = self._decode_attention(q, ck, cv, pos)
         y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
-        return x + linear(y, self._bw(bp, "attn.o.w"), None), ck, cv
+        return x + linear(y, self._bw(bp, "attn.o.w"), None), ks, vs
 
-    def _block_decode(self, x, bp, ck, cv, pos):
-        x, ck, cv = self._attn_decode(x, bp, ck, cv, pos)
+    def _block_decode(self, x, bp, ks, vs, l, pos):
+        x, ks, vs = self._attn_decode(x, bp, ks, vs, l, pos)
         h = rmsnorm(x, bp["ln_2.w"])
         gate = jax.nn.silu(linear(h, self._bw(bp, "mlp.gate.w"), None))
         up = linear(h, self._bw(bp, "mlp.up.w"), None)
-        return x + linear(gate * up, self._bw(bp, "mlp.down.w"), None), ck, cv
+        return x + linear(gate * up, self._bw(bp, "mlp.down.w"), None), ks, vs
 
     def _embed_decode(self, params, tok, pos):
         """No wpe table — position enters via RoPE inside each block."""

@@ -405,7 +405,7 @@ class MoEGPT(GPT2Model):
         (x, _aux), kv = self._block(x, bp, None, return_kv=True)
         return x, kv
 
-    def _block_decode(self, x, bp, ck, cv, pos):
+    def _block_decode(self, x, bp, ks, vs, l, pos):
         """Cached attention (GPT2Model._attn_decode) + routed experts on
         the single position, with DROP-FREE capacity S*k (the train-time
         cf*k*S/E formula collapses to ~1 slot at S=B and would drop tokens
@@ -413,13 +413,13 @@ class MoEGPT(GPT2Model):
         an over-capacity token the decode path keeps — inherent to
         static-capacity GShard routing; equality holds whenever neither
         path overflows."""
-        x, ck, cv = self._attn_decode(x, bp, ck, cv, pos)
+        x, ks, vs = self._attn_decode(x, bp, ks, vs, l, pos)
         h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
         s = x.shape[0]  # one position: S = B tokens routed together
         y, _aux = self._moe_mlp(
             h, bp, None, capacity=s * self.config.expert_top_k
         )
-        return x + y, ck, cv
+        return x + y, ks, vs
 
     def _quant_eligible(self, name, v):
         """Router excluded from the fp8 gather: routing logits need full
