@@ -307,3 +307,82 @@ class TestLongContext:
         assert temp < 4.5 * 2**30, f"temp {temp / 2**30:.2f} GB"
         state, loss = eng.step(state, (idx, idx))
         assert 0 < float(loss) < 20
+
+
+class TestGQAUlysses:
+    """Round 5: Ulysses carries K/V at kv_heads through the head/seq
+    all-to-all (reshard bytes / group) when the seq axis divides
+    kv_heads; parity vs the expand-first path."""
+
+    def test_llama_gqa_ulysses_matches_single_device(self):
+        from tiny_deepspeed_tpu import AdamW, SingleDevice, Zero2
+        from tiny_deepspeed_tpu.models.llama import LlamaConfig, LlamaModel
+        cfg = LlamaConfig(block_size=64, vocab_size=128, n_layer=2,
+                          n_head=4, n_kv_head=2, n_embd=32,
+                          compute_dtype=jnp.float32)
+        model = LlamaModel(cfg)
+        ref = SingleDevice(model, AdamW(lr=1e-3))
+        got = Zero2(model, AdamW(lr=1e-3), seq_parallel=2,
+                    seq_impl="ulysses")
+        s_ref = ref.init(jax.random.PRNGKey(0))
+        s_got = got.init(jax.random.PRNGKey(0))
+        for i in range(2):
+            kk = jax.random.split(jax.random.PRNGKey(20 + i), 2)
+            idx = jax.random.randint(kk[0], (8, 64), 0, 128)
+            tgt = jax.random.randint(kk[1], (8, 64), 0, 128)
+            s_ref, l_ref = ref.step(s_ref, (idx, tgt))
+            s_got, l_got = got.step(s_got, (idx, tgt))
+            np.testing.assert_allclose(float(l_got), float(l_ref),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_kv_bytes_shrink_on_tpu_hlo(self):
+        """The point of the grouped reshard, priced on the compiled v5e
+        program: with group 4, the four all-to-alls move q(16) + k(4) +
+        v(4) + out(16) = 40 head-panels instead of the expanded 64 —
+        exactly 0.625x (measured 1,966,080 vs 3,145,728 wire bytes)."""
+        import functools
+        import numpy as np_
+        from jax.experimental import topologies
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as Pp
+        from tiny_deepspeed_tpu.ops import flash_fa2
+        from tiny_deepspeed_tpu.ops.dispatch import kernel_target_forced
+        from tiny_deepspeed_tpu.parallel.ulysses import ulysses_attention
+        from tiny_deepspeed_tpu.utils.hlo_comm import collective_ledger
+        from tiny_deepspeed_tpu.ops.attention import gqa_flash_attention, \
+            flash_attention
+
+        try:
+            topo = topologies.get_topology_desc(
+                platform="tpu", topology_name="v5e:2x2")
+        except Exception as e:
+            pytest.skip(f"TPU topology unavailable: {e}")
+        mesh = Mesh(np_.array(topo.devices).reshape(4), ("seq",))
+        sh = lambda spec: NamedSharding(mesh, spec)
+        b, hq, hkv, t, d = 2, 16, 4, 1024, 64
+        spec = Pp(None, None, "seq", None)
+
+        def wire(kvh, attn_fn):
+            args = [
+                jax.ShapeDtypeStruct((b, hq, t, d), jnp.bfloat16,
+                                     sharding=sh(spec)),
+                jax.ShapeDtypeStruct((b, kvh, t, d), jnp.bfloat16,
+                                     sharding=sh(spec)),
+                jax.ShapeDtypeStruct((b, kvh, t, d), jnp.bfloat16,
+                                     sharding=sh(spec)),
+            ]
+
+            def f(q, k, v):
+                if attn_fn is flash_attention and kvh != hq:
+                    # the expand-first formulation this path replaces
+                    k = jnp.repeat(k, hq // kvh, axis=1)
+                    v = jnp.repeat(v, hq // kvh, axis=1)
+                return ulysses_attention(q, k, v, mesh, attn_fn=attn_fn)
+
+            with kernel_target_forced("tpu"):
+                text = jax.jit(f).lower(*args).compile().as_text()
+            return collective_ledger(text)["wire_bytes"].get(
+                "all-to-all", 0)
+
+        grouped = wire(hkv, gqa_flash_attention)
+        expanded = wire(hkv, flash_attention)
+        assert grouped <= 0.63 * expanded, (grouped, expanded)

@@ -161,12 +161,26 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
     head_axis = pctx.model_axis if pctx.tensor_parallel else None
 
     if pctx.seq_parallel:
-        # ring rotates K/V blocks and ulysses all-to-alls heads<->seq;
-        # both assume matching head counts — expand first (the repeat is
-        # sharded over the head/model axes, so it moves no extra bytes
-        # across the mesh)
-        k, v = _expand(k, v)
         ulysses = getattr(pctx, "seq_impl", "ring") == "ulysses"
+        # GQA x Ulysses (round 5): the head/seq all-to-all can carry K/V
+        # at kv_heads — the K/V reshard bytes drop by the group factor —
+        # because splitting H and KVH into the same n contiguous blocks
+        # preserves the group adjacency exactly when n | kv_heads
+        # (local q block [r*H/n,...) maps onto local kv block
+        # [r*KVH/n,...) with local index h' // group).  The ring and the
+        # partial-manual paths assume matching head counts — expand there
+        # (the repeat is sharded over the head/model axes, so it moves no
+        # extra bytes across the mesh).
+        tp_size = (pctx.mesh.shape[pctx.model_axis]
+                   if pctx.tensor_parallel else 1)
+        gqa_ulysses = (
+            rep > 1 and ulysses and not pctx.pipe_parallel
+            and impl == "flash_attention"
+            and (k.shape[1] // tp_size)
+            % pctx.mesh.shape[pctx.seq_axis] == 0
+        )
+        if not gqa_ulysses:
+            k, v = _expand(k, v)
         if pctx.pipe_parallel:
             # inside the pipeline's shard_map, which is manual over BOTH
             # {pipe, seq} (parallel/pipeline.py): q/k/v are already local
@@ -189,12 +203,14 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
             )
         if ulysses:
             # ulysses_attention's shard_map is FULLY manual (all axes in
-            # its specs), so the Pallas kernel runs per-shard safely
+            # its specs), so the Pallas kernel runs per-shard safely;
+            # with gqa_ulysses the local kernel consumes grouped K/V
+            # (gqa_flash_attention handles the off-TPU/oversize fallback)
             from ..parallel.ulysses import ulysses_attention
             return ulysses_attention(
                 q, k, v, pctx.mesh, seq_axis=pctx.seq_axis,
                 batch_axis=pctx.data_axis, head_axis=head_axis,
-                attn_fn=base_fn,
+                attn_fn=gqa_flash_attention if gqa_ulysses else base_fn,
             )
         return ring_attention(
             q, k, v, pctx.mesh, seq_axis=pctx.seq_axis,
