@@ -158,6 +158,21 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "host memory (pinned_host) instead of HBM; TPU runtime only",
     )
     p.add_argument(
+        "--offload-prefetch", type=int, default=2, metavar="W",
+        help="with --offload-opt-state: in-flight window of streamed "
+             "moment leaves (minimum 2 — the engine clamps lower values; "
+             "default 2; widening measured peak-HBM cost without "
+             "schedule benefit at leaf granularity — PROFILE.md round-5 "
+             "offload study)",
+    )
+    p.add_argument(
+        "--fused-xent", choices=("chunked", "pallas"), default=None,
+        help="fused lm_head+cross-entropy head: 'chunked' (XLA scan over "
+             "(B,chunk,V) slabs) or 'pallas' (round-5 kernel — logit "
+             "tiles live only in VMEM; TPU single-device, falls back to "
+             "chunked elsewhere).  Default: full-logits head",
+    )
+    p.add_argument(
         "--data", default=None, metavar="TOKENS.bin",
         help="binary uint16 token corpus (nanoGPT .bin convention); "
              "default: synthetic random tokens, the reference demo workload",
@@ -253,6 +268,9 @@ def run(engine_cls, args, single_device=False):
         model_cfg = _cfg_override("scan_unroll", True)
     if getattr(args, "moe_dispatch", None):
         model_cfg = _cfg_override("moe_dispatch", args.moe_dispatch)
+    if getattr(args, "fused_xent", None):
+        model_cfg = _cfg_override("fused_xent", True)
+        model_cfg = _cfg_override("fused_xent_impl", args.fused_xent)
     model = build_model(model_cfg)
 
     lr = args.lr
@@ -278,6 +296,7 @@ def run(engine_cls, args, single_device=False):
         grad_clip=getattr(args, "grad_clip", 0.0) or None,
         loss_scale=getattr(args, "loss_scale", None),
         offload_opt_state=getattr(args, "offload_opt_state", False),
+        offload_prefetch=getattr(args, "offload_prefetch", 2),
     )
     if single_device:
         engine = engine_cls(

@@ -200,6 +200,7 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
             return ring_attention_local(
                 q, k, v, axis_name=pctx.seq_axis,
                 axis_size=pctx.mesh.shape[pctx.seq_axis],
+                allow_kernel=False,  # data axis is GSPMD-auto here
             )
         if ulysses:
             # ulysses_attention's shard_map is FULLY manual (all axes in

@@ -30,9 +30,11 @@ Two implementations share that structure:
     + one (BH, 1, Tl) lse), so the round-4 memory proof (T=65536 on 8
     chips) carries over with the chunk compute now MXU-tiled instead of
     VPU-bound jnp (round-4 verdict item 3).
-  * elsewhere (CPU test mesh / shapes past the kernel's VMEM bound): the
-    original jnp online-softmax scan, body rematerialized so
-    differentiating it never stashes the (Tl, Tl) score matrices.
+  * elsewhere (CPU test mesh / shapes past the kernel's VMEM bound / the
+    pipeline's partial-manual region, where a Pallas custom call cannot
+    be auto-partitioned over the still-GSPMD data axis): the original
+    jnp online-softmax scan, body rematerialized so differentiating it
+    never stashes the (Tl, Tl) score matrices.
 """
 
 from __future__ import annotations
@@ -216,19 +218,26 @@ def _ring_fa2_bwd(axis_name, axis_size, res, g):
 _ring_fa2.defvjp(_ring_fa2_fwd, _ring_fa2_bwd)
 
 
-def ring_attention_local(q, k, v, *, axis_name: str, axis_size: int):
+def ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
+                         allow_kernel: bool = True):
     """Per-shard body (call inside shard_map over `axis_name`).
 
     q, k, v: (B, H, Tl, Dh) local sequence shards.  Returns (B, H, Tl, Dh).
     Routes to the FA2-kernel ring on TPU when the chunk's K/V panels fit
     the kernel's VMEM budget (Tl*Dh within the FA2_MAX_T bound — T=65536
     on an 8-ring is Tl=8192, comfortably inside); jnp fallback elsewhere.
+    `allow_kernel=False` forces the jnp body — the pipeline's partial-
+    manual region passes it because a Pallas custom call there cannot be
+    auto-partitioned over the still-GSPMD data axis (it would force a
+    per-chunk batch all-gather, the same hazard ops/attention.py's
+    `local_fn` note records for Ulysses-in-pipe).
     """
     from ..ops.attention_pallas import FA2_MAX_T
     from ..ops.dispatch import kernel_target
 
     tl, d = q.shape[2], q.shape[3]
-    if kernel_target() == "tpu" and tl * d <= FA2_MAX_T * 64:
+    if allow_kernel and kernel_target() == "tpu" \
+            and tl * d <= FA2_MAX_T * 64:
         return _ring_fa2(q, k, v, axis_name, axis_size)
     return _ring_jnp(q, k, v, axis_name=axis_name, axis_size=axis_size)
 
