@@ -648,13 +648,13 @@ def main():
 
     if sweep:
         models = ["gpt2-124m", "gpt2-350m", "gpt2-774m", "gpt2-1.5b",
-                  "llama-160m", "moe-8x124m"]
+                  "llama-160m", "llama-1b", "moe-8x124m"]
         for name in models:
             rec = None
             for attempt in range(3):  # inline retry for transient outages
                 try:
-                    rec = run_one(name, iters=10 if "1.5b" in name or "774m"
-                                  in name else 30)
+                    rec = run_one(name, iters=10 if "1.5b" in name
+                                  or "774m" in name or "1b" in name else 30)
                     rec["vs_baseline"] = 1.0
                     break
                 except Exception as e:  # noqa: BLE001 - keep sweeping

@@ -33,6 +33,8 @@ def bucket_for(name: str, d_model: int, vocab: int) -> str:
     head = name.split(" = ")[0]
     if head.startswith("%while"):
         return "SKIP"
+    if "_xent_" in name:
+        return "fused xent kernels"  # ops/xent_pallas.py (BENCH_XENT=pallas)
     if ("flash" in name or "_fwd_kernel" in name or "_bwd_dkv" in name
             or "_bwd_dq" in name):
         return "attention kernels"

@@ -66,8 +66,8 @@ def _pick_bs(s: int, want: int = 256) -> int:
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref,
-                m_acc, l_acc, g_acc, *, bv, v):
+def _xent_fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref,
+                     m_acc, l_acc, g_acc, *, bv, v):
     j = pl.program_id(1)
     nv = pl.num_programs(1)
     x = x_ref[...].astype(jnp.float32)          # (bs, d)
@@ -107,7 +107,7 @@ def _fwd(x, w, targets, *, bs, bv):
     nv = pl.cdiv(v, bv)
     t2 = targets.reshape(s, 1).astype(jnp.int32)
     loss, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, bv=bv, v=v),
+        functools.partial(_xent_fwd_kernel, bv=bv, v=v),
         grid=(s // bs, nv),
         in_specs=[
             pl.BlockSpec((bs, d), lambda i, j: (i, 0)),    # x
@@ -157,8 +157,8 @@ def _tile_dz(x_ref, w_ref, t_ref, lse_ref, gs_ref, j, *, bv, v):
     return dz * gs_ref[0, 0], x, w
 
 
-def _dx_kernel(x_ref, w_ref, t_ref, lse_ref, gs_ref, dx_ref, dx_acc,
-               *, bv, v):
+def _xent_dx_kernel(x_ref, w_ref, t_ref, lse_ref, gs_ref, dx_ref,
+                    dx_acc, *, bv, v):
     j = pl.program_id(1)
     nv = pl.num_programs(1)
 
@@ -176,8 +176,8 @@ def _dx_kernel(x_ref, w_ref, t_ref, lse_ref, gs_ref, dx_ref, dx_acc,
         dx_ref[...] = dx_acc[...].astype(dx_ref.dtype)
 
 
-def _dw_kernel(x_ref, w_ref, t_ref, lse_ref, gs_ref, dw_ref, dw_acc,
-               *, bv, v):
+def _xent_dw_kernel(x_ref, w_ref, t_ref, lse_ref, gs_ref, dw_ref,
+                    dw_acc, *, bv, v):
     # grid is (vocab-blocks, token-blocks): the dw tile stays resident
     # while token blocks stream through
     j = pl.program_id(0)
@@ -205,7 +205,7 @@ def _bwd(x, w, targets, lse, gscale, *, bs, bv_dx, bv_dw):
     gs = gscale.reshape(1, 1).astype(jnp.float32)
     stat = lambda i, j: (i, 0)
     dx = pl.pallas_call(
-        functools.partial(_dx_kernel, bv=bv_dx, v=v),
+        functools.partial(_xent_dx_kernel, bv=bv_dx, v=v),
         grid=(s // bs, pl.cdiv(v, bv_dx)),
         in_specs=[
             pl.BlockSpec((bs, d), lambda i, j: (i, 0)),      # x
@@ -222,7 +222,7 @@ def _bwd(x, w, targets, lse, gscale, *, bs, bv_dx, bv_dw):
 
     tok = lambda j, i: (i, 0)
     dw = pl.pallas_call(
-        functools.partial(_dw_kernel, bv=bv_dw, v=v),
+        functools.partial(_xent_dw_kernel, bv=bv_dw, v=v),
         grid=(pl.cdiv(v, bv_dw), s // bs),
         in_specs=[
             pl.BlockSpec((bs, d), tok),                      # x
