@@ -2,9 +2,12 @@
 # Copyright 2026 tiny-deepspeed-tpu authors
 # SPDX-License-Identifier: Apache-2.0
 
-# The round-3 TPU measurement batch (VERDICT items 1-3, 7-8): run the
-# moment the tunnel answers, most-important first, each step tolerant of
-# the tunnel dying again mid-batch.  Everything tees into $OUT.
+# The TPU measurement batch: run the moment the tunnel answers,
+# most-important first, each step tolerant of the tunnel dying again
+# mid-batch.  Round-5 ordering: the default bench (outage insurance)
+# first, then the ROUND-5 A/Bs (decode, xent kernel, GQA) while the
+# window is young — round 4 got ~2.5 h; a shorter window should still
+# answer this round's questions.  Everything tees into $OUT.
 cd "$(dirname "$0")/.." || exit 1
 OUT="${TPU_BATCH_OUT:-/tmp/tpu_batch}"
 mkdir -p "$OUT"
@@ -35,59 +38,17 @@ sys.exit(0 if r.get('value', 0) > 0
   fi
 fi
 
-log "2. autotuned bench (guardrail keeps the faster program)"
-timeout 3000 env BENCH_AUTOTUNE=1 python bench.py > "$OUT/bench_autotune.json" 2> "$OUT/bench_autotune.err"
-log "   rc=$? $(cat "$OUT/bench_autotune.json" 2>/dev/null | head -c 200)"
-
-log "3. 124M b=12 retest"
-timeout 2400 env BENCH_BATCH=12 python bench.py > "$OUT/bench_b12.json" 2> "$OUT/bench_b12.err"
-log "   rc=$? $(cat "$OUT/bench_b12.json" 2>/dev/null | head -c 200)"
-
-log "4. sweep (350m/774m/1.5b/llama-160m/moe-8x124m rows)"
-timeout 5400 python bench.py --sweep > "$OUT/bench_sweep.jsonl" 2> "$OUT/bench_sweep.err"
-log "   rc=$? rows=$(wc -l < "$OUT/bench_sweep.jsonl" 2>/dev/null)"
-
-log "5. decode throughput"
+log "2. decode throughput (round-5 in-place-cache restructure: vs 4,353 tok/s r4)"
 timeout 1800 env BENCH_DECODE=1 python bench.py > "$OUT/bench_decode.json" 2> "$OUT/bench_decode.err"
 log "   rc=$? $(cat "$OUT/bench_decode.json" 2>/dev/null | head -c 200)"
 
-log "6. long context T=4096 (B=2)"
-timeout 2400 env BENCH_SEQ=4096 BENCH_BATCH=2 python bench.py > "$OUT/bench_t4096.json" 2> "$OUT/bench_t4096.err"
-log "   rc=$? $(cat "$OUT/bench_t4096.json" 2>/dev/null | head -c 200)"
+log "3. Pallas fused lm_head+xent A/B (round-5 kernel, ops/xent_pallas.py)"
+for m in gpt2-124m gpt2-1.5b; do
+  timeout 1800 env BENCH_MODEL=$m BENCH_XENT=pallas python bench.py > "$OUT/bench_${m}_xent_pallas.json" 2> "$OUT/bench_${m}_xent_pallas.err"
+  log "   $m pallas-xent rc=$? $(cat "$OUT/bench_${m}_xent_pallas.json" 2>/dev/null | head -c 160)"
+done
 
-log "7. long context T=8192 (B=1)"
-timeout 2400 env BENCH_SEQ=8192 BENCH_BATCH=1 python bench.py > "$OUT/bench_t8192.json" 2> "$OUT/bench_t8192.err"
-log "   rc=$? $(cat "$OUT/bench_t8192.json" 2>/dev/null | head -c 200)"
-
-log "8. offload execution test (TPU-gated)"
-timeout 1200 python -m pytest tests/test_offload.py -q > "$OUT/offload.log" 2>&1
-log "   rc=$? $(tail -1 "$OUT/offload.log")"
-
-log "9. offload bench (1.5b HBM delta; round-5 default prefetch window 4)"
-timeout 2400 env BENCH_OFFLOAD=1 BENCH_MODEL=gpt2-1.5b python bench.py > "$OUT/bench_offload.json" 2> "$OUT/bench_offload.err"
-log "   rc=$? $(cat "$OUT/bench_offload.json" 2>/dev/null | head -c 200)"
-
-log "9b. offload prefetch-window A/B at 774M (w=4 at 1.5B compiles OVER-CHIP"
-log "    — 17.25 GB, round-5 AOT study — so the window A/B runs where"
-log "    there is headroom)"
-timeout 2400 env BENCH_OFFLOAD=1 BENCH_OFFLOAD_PREFETCH=2 BENCH_MODEL=gpt2-774m python bench.py > "$OUT/bench_offload_w2.json" 2> "$OUT/bench_offload_w2.err"
-log "   774m w=2 rc=$? $(cat "$OUT/bench_offload_w2.json" 2>/dev/null | head -c 160)"
-timeout 2400 env BENCH_OFFLOAD=1 BENCH_OFFLOAD_PREFETCH=4 BENCH_MODEL=gpt2-774m python bench.py > "$OUT/bench_offload_w4.json" 2> "$OUT/bench_offload_w4.err"
-log "   774m w=4 rc=$? $(cat "$OUT/bench_offload_w4.json" 2>/dev/null | head -c 160)"
-
-log "9c. offload per-op profile (async-copy bucket attribution)"
-timeout 1800 python scripts/profile_step.py --model gpt2-1.5b --offload --out "$OUT/xplane_offload" > "$OUT/profile_offload.json" 2> "$OUT/profile_offload.err"
-log "   rc=$? $(cat "$OUT/profile_offload.json" 2>/dev/null | head -c 300)"
-
-log "10. heads-last FA2 A/B (round-4 experiment, see scripts/fa2_bthd_ab.py)"
-timeout 1200 python scripts/fa2_bthd_ab.py > "$OUT/fa2_bthd_ab.jsonl" 2> "$OUT/fa2_bthd_ab.err"
-log "   rc=$? $(cat "$OUT/fa2_bthd_ab.jsonl" 2>/dev/null | tr '\n' ' ' | head -c 300)"
-
-log "11. MoE sort-dispatch A/B (round-4 experiment, MoEConfig.moe_dispatch)"
-timeout 1800 env BENCH_MODEL=moe-8x124m BENCH_MOE_DISPATCH=sort python bench.py > "$OUT/bench_moe_sort.json" 2> "$OUT/bench_moe_sort.err"
-log "   rc=$? $(cat "$OUT/bench_moe_sort.json" 2>/dev/null | head -c 200)"
-
-log "11b. GQA-native vs repeat A/B (round-5: ops/flash_fa2.py kv-indexed panels)"
+log "4. GQA-native vs repeat A/B (round-5: ops/flash_fa2.py kv-indexed panels)"
 for m in llama-160m llama-1b; do
   timeout 1800 env BENCH_MODEL=$m python bench.py > "$OUT/bench_${m}_gqa.json" 2> "$OUT/bench_${m}_gqa.err"
   log "   $m native rc=$? $(cat "$OUT/bench_${m}_gqa.json" 2>/dev/null | head -c 160)"
@@ -95,14 +56,56 @@ for m in llama-160m llama-1b; do
   log "   $m repeat rc=$? $(cat "$OUT/bench_${m}_repeat.json" 2>/dev/null | head -c 160)"
 done
 
-log "12. per-op profile of the default step (scripts/profile_step.py)"
+log "5. per-op profile of the default step (scripts/profile_step.py)"
 timeout 1200 python scripts/profile_step.py --out "$OUT/xplane" > "$OUT/profile_buckets.json" 2> "$OUT/profile_buckets.err"
 log "   rc=$? $(cat "$OUT/profile_buckets.json" 2>/dev/null | head -c 300)"
 
-log "13. Pallas fused lm_head+xent A/B (round-5 kernel, ops/xent_pallas.py)"
-for m in gpt2-124m gpt2-1.5b; do
-  timeout 1800 env BENCH_MODEL=$m BENCH_XENT=pallas python bench.py > "$OUT/bench_${m}_xent_pallas.json" 2> "$OUT/bench_${m}_xent_pallas.err"
-  log "   $m pallas-xent rc=$? $(cat "$OUT/bench_${m}_xent_pallas.json" 2>/dev/null | head -c 160)"
-done
+log "6. autotuned bench (guardrail keeps the faster program)"
+timeout 3000 env BENCH_AUTOTUNE=1 python bench.py > "$OUT/bench_autotune.json" 2> "$OUT/bench_autotune.err"
+log "   rc=$? $(cat "$OUT/bench_autotune.json" 2>/dev/null | head -c 200)"
+
+log "7. 124M b=12 retest"
+timeout 2400 env BENCH_BATCH=12 python bench.py > "$OUT/bench_b12.json" 2> "$OUT/bench_b12.err"
+log "   rc=$? $(cat "$OUT/bench_b12.json" 2>/dev/null | head -c 200)"
+
+log "8. sweep (350m/774m/1.5b/llama-160m/llama-1b/moe-8x124m rows)"
+timeout 6000 python bench.py --sweep > "$OUT/bench_sweep.jsonl" 2> "$OUT/bench_sweep.err"
+log "   rc=$? rows=$(wc -l < "$OUT/bench_sweep.jsonl" 2>/dev/null)"
+
+log "9. long context T=4096 (B=2)"
+timeout 2400 env BENCH_SEQ=4096 BENCH_BATCH=2 python bench.py > "$OUT/bench_t4096.json" 2> "$OUT/bench_t4096.err"
+log "   rc=$? $(cat "$OUT/bench_t4096.json" 2>/dev/null | head -c 200)"
+
+log "10. long context T=8192 (B=1)"
+timeout 2400 env BENCH_SEQ=8192 BENCH_BATCH=1 python bench.py > "$OUT/bench_t8192.json" 2> "$OUT/bench_t8192.err"
+log "   rc=$? $(cat "$OUT/bench_t8192.json" 2>/dev/null | head -c 200)"
+
+log "11. offload execution test (TPU-gated)"
+timeout 1200 python -m pytest tests/test_offload.py -q > "$OUT/offload.log" 2>&1
+log "   rc=$? $(tail -1 "$OUT/offload.log")"
+
+log "12. offload bench (1.5b HBM delta; default prefetch window 2)"
+timeout 2400 env BENCH_OFFLOAD=1 BENCH_MODEL=gpt2-1.5b python bench.py > "$OUT/bench_offload.json" 2> "$OUT/bench_offload.err"
+log "   rc=$? $(cat "$OUT/bench_offload.json" 2>/dev/null | head -c 200)"
+
+log "12b. offload prefetch-window A/B at 774M (w=4 at 1.5B compiles OVER-CHIP"
+log "    — 17.25 GB, round-5 AOT study — so the window A/B runs where"
+log "    there is headroom)"
+timeout 2400 env BENCH_OFFLOAD=1 BENCH_OFFLOAD_PREFETCH=2 BENCH_MODEL=gpt2-774m python bench.py > "$OUT/bench_offload_w2.json" 2> "$OUT/bench_offload_w2.err"
+log "   774m w=2 rc=$? $(cat "$OUT/bench_offload_w2.json" 2>/dev/null | head -c 160)"
+timeout 2400 env BENCH_OFFLOAD=1 BENCH_OFFLOAD_PREFETCH=4 BENCH_MODEL=gpt2-774m python bench.py > "$OUT/bench_offload_w4.json" 2> "$OUT/bench_offload_w4.err"
+log "   774m w=4 rc=$? $(cat "$OUT/bench_offload_w4.json" 2>/dev/null | head -c 160)"
+
+log "12c. offload per-op profile (async-copy bucket attribution)"
+timeout 1800 python scripts/profile_step.py --model gpt2-1.5b --offload --out "$OUT/xplane_offload" > "$OUT/profile_offload.json" 2> "$OUT/profile_offload.err"
+log "   rc=$? $(cat "$OUT/profile_offload.json" 2>/dev/null | head -c 300)"
+
+log "13. heads-last FA2 A/B (round-4 experiment, see scripts/fa2_bthd_ab.py)"
+timeout 1200 python scripts/fa2_bthd_ab.py > "$OUT/fa2_bthd_ab.jsonl" 2> "$OUT/fa2_bthd_ab.err"
+log "   rc=$? $(cat "$OUT/fa2_bthd_ab.jsonl" 2>/dev/null | tr '\n' ' ' | head -c 300)"
+
+log "14. MoE sort-dispatch A/B (MoEConfig.moe_dispatch; shard-local under DP since r5)"
+timeout 1800 env BENCH_MODEL=moe-8x124m BENCH_MOE_DISPATCH=sort python bench.py > "$OUT/bench_moe_sort.json" 2> "$OUT/bench_moe_sort.err"
+log "   rc=$? $(cat "$OUT/bench_moe_sort.json" 2>/dev/null | head -c 200)"
 
 log "batch complete; results in $OUT"
