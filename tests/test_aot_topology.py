@@ -368,3 +368,51 @@ class TestTpuTopologyHLO:
                 state, _aot._batch_structs(eng, 4, 128)).compile()
         # fwd + dx + dw xent calls (attention kernels add their own)
         assert compiled.as_text().count("tpu_custom_call") >= 3
+
+    def test_gqa_ring_rotation_bytes_shrink(self, topo_mesh):
+        """Round 5: the ring rotates K/V (and the backward's dk/dv
+        accumulators) at kv_heads — collective-permute wire bytes of the
+        compiled f+b program must shrink toward 1/group vs the
+        expand-first ring (q-side traffic is zero in the ring, so unlike
+        Ulysses there is no full-head floor; small deviation comes from
+        the f32 accumulator halves)."""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from tiny_deepspeed_tpu.parallel.ring_attention import (
+            ring_attention_local,
+        )
+
+        b, hq, hkv, t, d = 1, 8, 2, 4096, 64
+        spec = P(None, None, "data", None)
+        sh = NamedSharding(topo_mesh, spec)
+
+        def wire(kvh):
+            fn = jax.shard_map(
+                functools.partial(ring_attention_local, axis_name="data",
+                                  axis_size=8),
+                mesh=topo_mesh, in_specs=(spec,) * 3, out_specs=spec,
+                check_vma=False)
+            args = [
+                jax.ShapeDtypeStruct((b, hq, t, d), jnp.bfloat16,
+                                     sharding=sh),
+                jax.ShapeDtypeStruct((b, kvh, t, d), jnp.bfloat16,
+                                     sharding=sh),
+                jax.ShapeDtypeStruct((b, kvh, t, d), jnp.bfloat16,
+                                     sharding=sh),
+            ]
+
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v).astype(jnp.float32))
+
+            with kernel_target_forced("tpu"):
+                text = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+                    *args).compile().as_text()
+            led = collective_ledger(text)
+            assert not led["unresolved_loops"], led["unresolved_loops"]
+            return led["wire_bytes"].get("collective-permute", 0)
+
+        grouped = wire(hkv)
+        expanded = wire(hq)
+        assert grouped < 0.35 * expanded, (grouped, expanded)

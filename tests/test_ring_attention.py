@@ -98,6 +98,34 @@ class TestRingAttention:
             # (T/n, T) f32 = 128 MB x 4 heads)
             assert ring_b < 300 * 2**20, ring_b
 
+    def test_gqa_jnp_ring_matches_expanded(self):
+        """The jnp fallback body is GQA-aware too (grouped einsum): K/V
+        at kv_heads match the expand-first numbers, fwd + grads."""
+        mesh = make_mesh(axis_names=("seq",))
+        ks = jax.random.split(jax.random.PRNGKey(4), 3)
+        B, H, KVH, T, D = 2, 6, 2, 64, 16  # group 3 (non-power-of-two)
+        q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, KVH, T, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, KVH, T, D), jnp.float32)
+        rep = H // KVH
+
+        def grouped(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
+
+        def expanded(q, k, v):
+            return jnp.sum(ring_attention(
+                q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+                mesh) ** 2)
+
+        np.testing.assert_allclose(
+            float(grouped(q, k, v)), float(expanded(q, k, v)),
+            rtol=1e-5, atol=1e-6)
+        g1 = jax.grad(grouped, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(expanded, argnums=(0, 1, 2))(q, k, v)
+        assert g1[1].shape == (B, KVH, T, D)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
     def test_grads_flow(self):
         mesh = make_mesh(axis_names=("seq",))
         q, k, v = qkv()
@@ -166,6 +194,36 @@ class TestFA2Ring:
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
             check_vma=False)(q, k, v)
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_gqa_ring_matches_expanded(self):
+        """Round 5: K/V rotate at kv_heads through the FA2 ring — same
+        numbers (fwd + all grads) as repeating them to the query head
+        count first; dk/dv come back at kv_heads."""
+        mesh = make_mesh(axis_names=("seq",))
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        B, H, KVH, T, D = 2, 4, 2, 128, 16
+        q = jax.random.normal(ks[0], (B, H, T, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, KVH, T, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, KVH, T, D), jnp.float32)
+        rep = H // KVH
+
+        def grouped(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
+
+        def expanded(q, k, v):
+            return jnp.sum(ring_attention(
+                q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+                mesh) ** 2)
+
+        np.testing.assert_allclose(
+            float(grouped(q, k, v)), float(expanded(q, k, v)),
+            rtol=1e-5, atol=1e-6)
+        g1 = jax.grad(grouped, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(expanded, argnums=(0, 1, 2))(q, k, v)
+        assert g1[1].shape == (B, KVH, T, D)
+        for name, a, b in zip("qkv", g1, g2):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name}")
 
 
 class TestUlysses:

@@ -179,7 +179,12 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
             and (k.shape[1] // tp_size)
             % pctx.mesh.shape[pctx.seq_axis] == 0
         )
-        if not gqa_ulysses:
+        # the ring takes grouped K/V everywhere (round 5): both its
+        # bodies are GQA-aware (kernel: kv-indexed panels; jnp: grouped
+        # einsum), so the rotating K/V — the ring's dominant wire term —
+        # and the backward's dk/dv accumulators move at kv_heads.
+        gqa_ring = rep > 1 and not ulysses
+        if not (gqa_ulysses or gqa_ring):
             k, v = _expand(k, v)
         if pctx.pipe_parallel:
             # inside the pipeline's shard_map, which is manual over BOTH

@@ -327,28 +327,34 @@ def _bwd(res, g, *, scale, bq, bk, group=1):
 # ---------------------------------------------------------------------------
 
 
-def fa2_chunk_fwd(q, k, v, *, causal: bool, block: int = 512):
-    """(BH, T, D) panels -> (o normalized within the chunk, lse (BH,1,T))."""
+def fa2_chunk_fwd(q, k, v, *, causal: bool, block: int = 512,
+                  group: int = 1):
+    """(BH, T, D) panels -> (o normalized within the chunk, lse (BH,1,T)).
+    `group` > 1: k/v carry BH//group KV-head panels (GQA — the ring
+    rotates them at kv_heads, cutting its dominant wire term)."""
     bh, t, d = q.shape
     bq, bk = _pick(t, block), _pick(t, block)
     return _fwd(q, k, v, scale=1.0 / math.sqrt(d), bq=bq, bk=bk,
-                causal=causal)
+                causal=causal, group=group)
 
 
-def fa2_chunk_dq(q, k, v, do, lse, di, *, causal: bool, block: int = 512):
+def fa2_chunk_dq(q, k, v, do, lse, di, *, causal: bool, block: int = 512,
+                 group: int = 1):
     """dq of one chunk given the GLOBAL (merged) lse and di stats."""
     bh, t, d = q.shape
     bq, bk = _pick(t, block), _pick(t, block)
     return _dq_call(q, k, v, do, lse, di, scale=1.0 / math.sqrt(d),
-                    bq=bq, bk=bk, causal=causal)
+                    bq=bq, bk=bk, causal=causal, group=group)
 
 
-def fa2_chunk_dkv(q, k, v, do, lse, di, *, causal: bool, block: int = 512):
-    """(dk, dv) of one chunk given the GLOBAL (merged) lse and di stats."""
+def fa2_chunk_dkv(q, k, v, do, lse, di, *, causal: bool, block: int = 512,
+                  group: int = 1):
+    """(dk, dv) of one chunk given the GLOBAL (merged) lse and di stats;
+    dk/dv return at the k/v (KV-head) panel count."""
     bh, t, d = q.shape
     bq, bk = _pick(t, block), _pick(t, block)
     return _dkv_call(q, k, v, do, lse, di, scale=1.0 / math.sqrt(d),
-                     bq=bq, bk=bk, causal=causal)
+                     bq=bq, bk=bk, causal=causal, group=group)
 
 
 # ---------------------------------------------------------------------------

@@ -50,8 +50,18 @@ _NEG = -1e30  # finite -inf stand-in: avoids NaN from (-inf) - (-inf)
 
 
 def _ring_jnp(q, k, v, *, axis_name: str, axis_size: int):
-    """jnp online-softmax ring body (the non-Pallas fallback path)."""
+    """jnp online-softmax ring body (the non-Pallas fallback path).
+
+    GQA: k/v may carry KVH < H heads — the einsums run with q folded to
+    (B, KVH, G, Tl, Dh) so the rotating K/V stay at kv_heads (the same
+    wire saving as the kernel path, in the fallback dialect)."""
     b, h, tl, d = q.shape
+    hkv = k.shape[1]
+    if hkv != h:
+        g = h // hkv
+        o = _ring_jnp_gqa(q.reshape(b, hkv, g, tl, d), k, v,
+                          axis_name=axis_name, axis_size=axis_size)
+        return o.reshape(b, h, tl, d)
     scale = 1.0 / math.sqrt(d)
     my = jax.lax.axis_index(axis_name)
 
@@ -99,6 +109,50 @@ def _ring_jnp(q, k, v, *, axis_name: str, axis_size: int):
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def _ring_jnp_gqa(qg, k, v, *, axis_name: str, axis_size: int):
+    """Grouped-query jnp ring: qg (B, KVH, G, Tl, Dh), k/v (B, KVH, Tl,
+    Dh) rotating unexpanded.  Same online-softmax merge as _ring_jnp with
+    a grouped-head axis riding along."""
+    b, hkv, g, tl, d = qg.shape
+    scale = 1.0 / math.sqrt(d)
+    my = jax.lax.axis_index(axis_name)
+
+    qf = qg.astype(jnp.float32)
+    q_pos = my * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+
+    o0 = jnp.zeros((b, hkv, g, tl, d), jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, tl, 1), jnp.float32)
+    m0 = jnp.full((b, hkv, g, tl, 1), _NEG, jnp.float32)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(carry, i):
+        o, l, m, kc, vc = carry
+        src = (my - i) % axis_size
+        s = jnp.einsum(
+            "bkgqd,bktd->bkgqt", qf, kc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = src * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
+        mask = q_pos >= k_pos
+        s = jnp.where(mask[None, None, None], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask[None, None, None], jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.einsum(
+            "bkgqt,bktd->bkgqd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o, l, m_new, kc, vc), None
+
+    (o, l, _, _, _), _ = jax.lax.scan(
+        jax.checkpoint(step), (o0, l0, m0, k, v), jnp.arange(axis_size)
+    )
+    return (o / jnp.maximum(l, 1e-30)).astype(qg.dtype)
+
+
 # ---------------------------------------------------------------------------
 # FA2-kernel ring (TPU path)
 # ---------------------------------------------------------------------------
@@ -119,13 +173,16 @@ def _ring_fa2_fwd(q, k, v, axis_name, axis_size):
     from ..ops.flash_fa2 import fa2_chunk_fwd
 
     b, h, tl, d = q.shape
+    kvh = k.shape[1]          # GQA: K/V rotate UNEXPANDED at kv_heads
+    group = h // kvh
     bh = b * h
-    flat = lambda x: x.reshape(bh, tl, d)
-    qf, kf, vf = flat(q), flat(k), flat(v)
+    qf = q.reshape(bh, tl, d)
+    kf = k.reshape(b * kvh, tl, d)
+    vf = v.reshape(b * kvh, tl, d)
     my = jax.lax.axis_index(axis_name)
 
     # peeled diagonal: global offsets equal -> plain causal at local coords
-    o0, lse0 = fa2_chunk_fwd(qf, kf, vf, causal=True)
+    o0, lse0 = fa2_chunk_fwd(qf, kf, vf, causal=True, group=group)
     o_run, lse_run = o0.astype(jnp.float32), lse0  # (bh,tl,d), (bh,1,tl)
 
     def step(carry, i):
@@ -138,7 +195,8 @@ def _ring_fa2_fwd(q, k, v, axis_name, axis_size):
         # (the jnp path spends a full masked matmul on them)
 
         def compute(_):
-            o_c, lse_c = fa2_chunk_fwd(qf, kc, vc, causal=False)
+            o_c, lse_c = fa2_chunk_fwd(qf, kc, vc, causal=False,
+                                       group=group)
             return o_c.astype(jnp.float32), lse_c
 
         def skip(_):
@@ -165,9 +223,13 @@ def _ring_fa2_bwd(axis_name, axis_size, res, g):
 
     q, k, v, o, lse = res
     b, h, tl, d = q.shape
+    kvh = k.shape[1]
+    group = h // kvh
     bh = b * h
     flat = lambda x: x.reshape(bh, tl, d)
-    qf, kf, vf, of, do = flat(q), flat(k), flat(v), flat(o), flat(g)
+    qf, of, do = flat(q), flat(o), flat(g)
+    kf = k.reshape(b * kvh, tl, d)
+    vf = v.reshape(b * kvh, tl, d)
     di = jnp.sum(do.astype(jnp.float32) * of.astype(jnp.float32),
                  axis=-1)[:, None, :]  # (bh, 1, tl) f32
     my = jax.lax.axis_index(axis_name)
@@ -177,9 +239,10 @@ def _ring_fa2_bwd(axis_name, axis_size, res, g):
     # device and the gradient being accumulated FOR that chunk travel as
     # one, so after a full cycle each device holds its own chunk's
     # complete dk/dv (comm = 2x the forward's k/v bytes, the f32 ledger
-    # price of exact accumulation).
-    dq0 = fa2_chunk_dq(qf, kf, vf, do, lse, di, causal=True)
-    dk0, dv0 = fa2_chunk_dkv(qf, kf, vf, do, lse, di, causal=True)
+    # price of exact accumulation — all of it at kv_heads under GQA).
+    dq0 = fa2_chunk_dq(qf, kf, vf, do, lse, di, causal=True, group=group)
+    dk0, dv0 = fa2_chunk_dkv(qf, kf, vf, do, lse, di, causal=True,
+                             group=group)
     dq_run = dq0.astype(jnp.float32)
     dka, dva = dk0.astype(jnp.float32), dv0.astype(jnp.float32)
 
@@ -191,15 +254,16 @@ def _ring_fa2_bwd(axis_name, axis_size, res, g):
         dva = _rot(dva, axis_name, axis_size)
 
         def compute(_):
-            dq_c = fa2_chunk_dq(qf, kc, vc, do, lse, di, causal=False)
+            dq_c = fa2_chunk_dq(qf, kc, vc, do, lse, di, causal=False,
+                                group=group)
             dk_c, dv_c = fa2_chunk_dkv(qf, kc, vc, do, lse, di,
-                                       causal=False)
+                                       causal=False, group=group)
             return (dq_c.astype(jnp.float32), dk_c.astype(jnp.float32),
                     dv_c.astype(jnp.float32))
 
         def skip(_):
-            z = jnp.zeros((bh, tl, d), jnp.float32)
-            return z, z, z
+            zkv = jnp.zeros((b * kvh, tl, d), jnp.float32)
+            return jnp.zeros((bh, tl, d), jnp.float32), zkv, zkv
 
         dq_c, dk_c, dv_c = jax.lax.cond(i <= my, compute, skip, None)
         return (kc, vc, dka + dk_c, dva + dv_c, dq_run + dq_c), None
@@ -211,8 +275,9 @@ def _ring_fa2_bwd(axis_name, axis_size, res, g):
         dka = _rot(dka, axis_name, axis_size)
         dva = _rot(dva, axis_name, axis_size)
 
-    unflat = lambda x, dt: x.astype(dt).reshape(b, h, tl, d)
-    return unflat(dq_run, q.dtype), unflat(dka, k.dtype), unflat(dva, v.dtype)
+    return (dq_run.astype(q.dtype).reshape(b, h, tl, d),
+            dka.astype(k.dtype).reshape(b, kvh, tl, d),
+            dva.astype(v.dtype).reshape(b, kvh, tl, d))
 
 
 _ring_fa2.defvjp(_ring_fa2_fwd, _ring_fa2_bwd)
@@ -234,10 +299,13 @@ def ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
     """
     from ..ops.attention_pallas import FA2_MAX_T
     from ..ops.dispatch import kernel_target
+    from ..ops.flash_fa2 import fa2_gqa_supported
 
     tl, d = q.shape[2], q.shape[3]
+    group = q.shape[1] // k.shape[1]  # GQA: k/v arrive at kv_heads
     if allow_kernel and kernel_target() == "tpu" \
-            and tl * d <= FA2_MAX_T * 64:
+            and tl * d <= FA2_MAX_T * 64 \
+            and fa2_gqa_supported(tl, d, group):
         return _ring_fa2(q, k, v, axis_name, axis_size)
     return _ring_jnp(q, k, v, axis_name=axis_name, axis_size=axis_size)
 
