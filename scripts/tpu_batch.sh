@@ -42,6 +42,10 @@ log "2. decode throughput (round-5 in-place-cache restructure: vs 4,353 tok/s r4
 timeout 1800 env BENCH_DECODE=1 python bench.py > "$OUT/bench_decode.json" 2> "$OUT/bench_decode.err"
 log "   rc=$? $(cat "$OUT/bench_decode.json" 2>/dev/null | head -c 200)"
 
+log "2b. llama-160m decode (grouped KV cache path, first chip measurement)"
+timeout 1800 env BENCH_DECODE=1 BENCH_MODEL=llama-160m python bench.py > "$OUT/bench_decode_llama.json" 2> "$OUT/bench_decode_llama.err"
+log "   rc=$? $(cat "$OUT/bench_decode_llama.json" 2>/dev/null | head -c 200)"
+
 log "3. Pallas fused lm_head+xent A/B (round-5 kernel, ops/xent_pallas.py)"
 for m in gpt2-124m gpt2-1.5b; do
   timeout 1800 env BENCH_MODEL=$m BENCH_XENT=pallas python bench.py > "$OUT/bench_${m}_xent_pallas.json" 2> "$OUT/bench_${m}_xent_pallas.err"
