@@ -364,6 +364,19 @@ def _bench_config(model_name: str):
                      dict(batch=8, overrides={}, state_dtype=None))
 
 
+def _effective_xent_impl(cfg, n_chips: int) -> str:
+    """The loss-head implementation a step with this config actually runs
+    (models/gpt2.py head gate): 'unfused' without fused_xent, 'pallas'
+    only on a single-device TPU-kernel target, else 'chunked'."""
+    if not cfg.fused_xent:
+        return "unfused"
+    from tiny_deepspeed_tpu.ops.dispatch import kernel_target
+    if (getattr(cfg, "fused_xent_impl", "chunked") == "pallas"
+            and kernel_target() == "tpu" and n_chips == 1):
+        return "pallas"
+    return "chunked"
+
+
 def run_one(model_name: str, b=None, t=1024, iters=30):
     import jax
     import jax.numpy as jnp
@@ -553,8 +566,10 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             "effective": {
                 "remat": str(cfg.remat),
                 "fused_xent": str(cfg.fused_xent),
-                "fused_xent_impl": str(
-                    getattr(cfg, "fused_xent_impl", "chunked")),
+                # the IMPL THAT RAN, mirroring gpt2.head's gate (pallas
+                # needs fused_xent + TPU kernels + a single device) — not
+                # the knob verbatim, which would mislabel fallback runs
+                "fused_xent_impl": _effective_xent_impl(cfg, n_chips),
                 "scan_unroll": str(cfg.scan_unroll),
             },
             "config": {

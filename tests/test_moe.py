@@ -166,6 +166,23 @@ class TestSortDispatch:
         (l2, *_), _ = run_steps(e2, n=1)
         assert abs(l1 - l2) < 1e-4, (l1, l2)
 
+    def test_pure_dp_sort_composes_with_fp8_gather(self):
+        """The '#scale' companions must cross the shard_map boundary
+        with their f8 leaves — without them _bw hands the expert einsums
+        raw float8 weights (round-5 review finding).  Loss must stay
+        close to the unquantized sort path."""
+        import dataclasses
+        from tiny_deepspeed_tpu import Zero1
+        cfg_q = dataclasses.replace(CFG, moe_dispatch="sort",
+                                    capacity_factor=4.0,
+                                    gather_quant="fp8")
+        cfg_p = dataclasses.replace(CFG, moe_dispatch="sort",
+                                    capacity_factor=4.0)
+        (lq, *_), _ = run_steps(Zero1(MoEGPT(cfg_q), AdamW(lr=1e-3)), n=1)
+        (lp, *_), _ = run_steps(Zero1(MoEGPT(cfg_p), AdamW(lr=1e-3)), n=1)
+        assert np.isfinite(lq)
+        assert abs(lq - lp) < 0.05 * max(1.0, abs(lp)), (lq, lp)
+
     def test_effective_dispatch_predicate(self):
         """The single fallback predicate bench.py records: sort survives
         single-device and pure DP, falls back under ep/tp/sp/pipe."""

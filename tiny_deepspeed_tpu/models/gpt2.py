@@ -326,7 +326,8 @@ class GPT2Model:
         b, hq, _, dh = q.shape
         hkv = ck.shape[1]
         scale = 1.0 / math.sqrt(dh)
-        q = q.astype(ck.dtype)
+        out_dtype = q.dtype  # restore the ACTIVATION dtype on return,
+        q = q.astype(ck.dtype)  # not the (future-knob) cache dtype
         mask = jnp.arange(ck.shape[2]) <= pos
         if hq != hkv:
             g = hq // hkv
@@ -345,7 +346,7 @@ class GPT2Model:
             att = jax.nn.softmax(att, axis=-1)
             y = jnp.einsum("bhqt,bhtd->bhqd", att.astype(cv.dtype), cv,
                            preferred_element_type=jnp.float32)
-        return y.astype(q.dtype)
+        return y.astype(out_dtype)
 
     def _attn_decode(self, x, bp, ks, vs, l, pos):
         """Attention half of one decode step on the STACKED (L, B, Hkv,

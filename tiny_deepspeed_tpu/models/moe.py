@@ -304,11 +304,16 @@ class MoEGPT(GPT2Model):
             # each device dispatches its LOCAL token shard with a local
             # argsort (capacity prorated by shard size) — mathematically
             # the same routing, no global sort, no extra communication.
-            # The fp8 _bw constraint is skipped inside the manual region
-            # (the weight gathers are forced at the shard_map boundary).
+            # The fp8 '#scale' companions MUST cross the shard_map
+            # boundary too, or _bw inside the manual region would see no
+            # scale and hand the expert einsums raw float8 weights; the
+            # _bw sharding constraint itself is skipped in there
+            # (pctx=None — the gathers are forced at the boundary).
             from jax.sharding import PartitionSpec as P
-            names = [n for n in ("moe.router.w", "moe.fc.w", "moe.fc.b",
-                                 "moe.proj.w", "moe.proj.b") if n in bp]
+            names = [n for base in ("moe.router.w", "moe.fc.w",
+                                    "moe.fc.b", "moe.proj.w",
+                                    "moe.proj.b")
+                     for n in (base, base + "#scale") if n in bp]
             dax = pctx.data_axis
 
             def local(xs_l, *ws):

@@ -218,9 +218,12 @@ def sharded_attention(q, k, v, impl: str, pctx=None):
                 batch_axis=pctx.data_axis, head_axis=head_axis,
                 attn_fn=gqa_flash_attention if gqa_ulysses else base_fn,
             )
+        # attn_impl="standard_attention" keeps its kernel-free meaning
+        # under the ring too: the jnp body runs, not the FA2 chunks
         return ring_attention(
             q, k, v, pctx.mesh, seq_axis=pctx.seq_axis,
             batch_axis=pctx.data_axis, head_axis=head_axis,
+            allow_kernel=impl == "flash_attention",
         )
 
     if pctx.pipe_parallel:

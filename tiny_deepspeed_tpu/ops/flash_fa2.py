@@ -366,11 +366,10 @@ _INTERPRET = False  # tests flip this on CPU (no Mosaic backend there)
 
 # GQA VMEM bound: the dkv pass holds the kv head's whole query-head
 # group of Q and dO panels VMEM-resident — group * t * d elements each
-# (bf16).  2M elements = 4 MB/panel, 8 MB for the pair, inside the
-# ~16 MB/core budget next to the k/v blocks and f32 scratch.  At
-# group=1 this is exactly the FA2_MAX_T=16384 (d=64) bound the
-# dispatch layer already applies.
-_GQA_MAX_PANEL = 2 * 1024 * 1024
+# (bf16).  1M elements = 2 MB/panel, 4 MB for the pair, matching the
+# per-panel envelope the MHA dispatch bound was tuned to (at group=1
+# this is exactly FA2_MAX_T=16384 at d=64: 16384*64 = 1,048,576).
+_GQA_MAX_PANEL = 1024 * 1024
 
 
 def fa2_gqa_supported(t: int, d: int, group: int) -> bool:

@@ -52,67 +52,31 @@ _NEG = -1e30  # finite -inf stand-in: avoids NaN from (-inf) - (-inf)
 def _ring_jnp(q, k, v, *, axis_name: str, axis_size: int):
     """jnp online-softmax ring body (the non-Pallas fallback path).
 
-    GQA: k/v may carry KVH < H heads — the einsums run with q folded to
-    (B, KVH, G, Tl, Dh) so the rotating K/V stay at kv_heads (the same
-    wire saving as the kernel path, in the fallback dialect)."""
+    ONE implementation for MHA and GQA: q folds to (B, KVH, G, Tl, Dh)
+    — G=1 when the head counts match — so the rotating K/V always move
+    at kv_heads (the same wire saving as the kernel path, in the
+    fallback dialect) and there is a single scan body to maintain."""
     b, h, tl, d = q.shape
     hkv = k.shape[1]
-    if hkv != h:
-        g = h // hkv
-        o = _ring_jnp_gqa(q.reshape(b, hkv, g, tl, d), k, v,
-                          axis_name=axis_name, axis_size=axis_size)
-        return o.reshape(b, h, tl, d)
-    scale = 1.0 / math.sqrt(d)
-    my = jax.lax.axis_index(axis_name)
-
-    qf = q.astype(jnp.float32)
-    q_pos = my * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
-
-    o0 = jnp.zeros((b, h, tl, d), jnp.float32)
-    l0 = jnp.zeros((b, h, tl, 1), jnp.float32)
-    m0 = jnp.full((b, h, tl, 1), _NEG, jnp.float32)
-    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
-
-    def step(carry, i):
-        o, l, m, kc, vc = carry
-        src = (my - i) % axis_size  # global block id of kc/vc
-        s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        k_pos = src * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
-        mask = q_pos >= k_pos  # (tl, tl) causal at global positions
-        s = jnp.where(mask, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        o = o * corr + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        kc = jax.lax.ppermute(kc, axis_name, perm)
-        vc = jax.lax.ppermute(vc, axis_name, perm)
-        return (o, l, m_new, kc, vc), None
-
-    # remat the BODY: differentiating a scan stashes each step's residuals,
-    # and this body's are the (Tl, Tl) score/probability matrices — at
-    # T=32k/ring=8 that is axis_size x (B, H, 4096, 4096) f32, a ~26 GB
-    # stack that defeats the O(T/n) memory claim (first seen on the
-    # round-4 TPU-topology compile).  checkpoint saves only the step
-    # inputs (the rotating K/V carries, O(n * Tl * d) total) and recomputes
-    # scores in the backward — the standard ring-attention backward, which
-    # re-runs the ring's ppermutes for the recompute.
-    (o, l, _, _, _), _ = jax.lax.scan(
-        jax.checkpoint(step), (o0, l0, m0, k, v), jnp.arange(axis_size)
-    )
-    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    g = h // hkv
+    o = _ring_jnp_gqa(q.reshape(b, hkv, g, tl, d), k, v,
+                      axis_name=axis_name, axis_size=axis_size)
+    return o.reshape(b, h, tl, d)
 
 
 def _ring_jnp_gqa(qg, k, v, *, axis_name: str, axis_size: int):
     """Grouped-query jnp ring: qg (B, KVH, G, Tl, Dh), k/v (B, KVH, Tl,
-    Dh) rotating unexpanded.  Same online-softmax merge as _ring_jnp with
-    a grouped-head axis riding along."""
+    Dh) rotating unexpanded, online (flash-style) running max/sum
+    softmax merged across chunks.
+
+    The scan BODY is rematerialized: differentiating the scan would
+    stash each step's (Tl, Tl)-per-head score/probability matrices — at
+    T=32k/ring=8 a ~26 GB stack that defeats the O(T/n) memory claim
+    (first seen on the round-4 TPU-topology compile).  checkpoint saves
+    only the step inputs (the rotating K/V carries, O(n * Tl * d)
+    total) and recomputes scores in the backward — the standard
+    ring-attention backward, which re-runs the ring's ppermutes for the
+    recompute."""
     b, hkv, g, tl, d = qg.shape
     scale = 1.0 / math.sqrt(d)
     my = jax.lax.axis_index(axis_name)
@@ -311,14 +275,18 @@ def ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
 
 
 def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = "seq",
-                   batch_axis=None, head_axis=None):
+                   batch_axis=None, head_axis=None,
+                   allow_kernel: bool = True):
     """shard_map entry: q/k/v (B, H, T, Dh) with T sharded over `seq_axis`
     (optionally B over `batch_axis` and H over `head_axis` — heads split
-    across a tensor-parallel axis compose freely with the sequence ring)."""
+    across a tensor-parallel axis compose freely with the sequence ring).
+    `allow_kernel=False` forces the jnp body (attn_impl=
+    "standard_attention" keeps its kernel-free meaning under the ring)."""
     n = mesh.shape[seq_axis]
     spec = P(batch_axis, head_axis, seq_axis, None)
     fn = functools.partial(
-        ring_attention_local, axis_name=seq_axis, axis_size=n
+        ring_attention_local, axis_name=seq_axis, axis_size=n,
+        allow_kernel=allow_kernel,
     )
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
