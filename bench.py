@@ -32,6 +32,7 @@ import dataclasses
 import glob
 import json
 import os
+import re
 import sys
 import time
 
@@ -543,6 +544,26 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     matmul_mfu = flops_tok_matmul * toks_per_sec_total / n_chips / peak
     mfu_6n = 6 * n_params * toks_per_sec_total / n_chips / peak
 
+    # telemetry sidecar: measured collective ledger + a few instrumented
+    # steps, so scripts/report_run.py can render this bench run.  Best
+    # effort — a sidecar failure must never zero the headline number.
+    tel_path = os.environ.get("BENCH_TELEMETRY_JSONL") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "artifacts",
+        f"bench_telemetry_{model_name}.jsonl",
+    )
+    try:
+        tel_dir = os.path.dirname(tel_path)
+        if tel_dir:  # BENCH_TELEMETRY_JSONL may be a bare filename
+            os.makedirs(tel_dir, exist_ok=True)
+        _write_bench_telemetry(
+            tel_path, engine, state, (idx, tgt), compiled_step.as_text(),
+            model_name, n_chips, b, t, peak,
+        )
+    except Exception as e:  # noqa: BLE001 - observability is non-fatal
+        print(f"bench: telemetry sidecar failed: {e!r:.200}",
+              file=sys.stderr)
+        tel_path = None
+
     return {
         "metric": f"{model_name}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_chip, 1),
@@ -575,6 +596,7 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             "config": {
                 k: str(v) for k, v in _bench_config(model_name).items()
             },
+            **({"telemetry_jsonl": tel_path} if tel_path else {}),
         },
     }
 
@@ -620,11 +642,21 @@ def run_decode(model_name: str, b=8, prompt_t=128, new_tokens=256):
     }
 
 
-def _vs_prev_round(value: float) -> float:
-    prev = 1.0
-    for path in sorted(glob.glob(os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")),
-            reverse=True):
+def _round_number(path: str) -> int:
+    m = re.search(r"BENCH_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def _prev_round_value():
+    """Latest prior round's nonzero headline value, or None on a fresh
+    cycle (no usable BENCH_r*.json — the trajectory is []).  Rounds order
+    NUMERICALLY: from round 10 on, a lexicographic sort would put r9
+    ahead of r10 and compare against the wrong round."""
+    for path in sorted(
+            glob.glob(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_r*.json")),
+            key=_round_number, reverse=True):
         try:
             with open(path) as f:
                 rec = json.load(f)
@@ -632,10 +664,52 @@ def _vs_prev_round(value: float) -> float:
             if prev_val is None and isinstance(rec.get("parsed"), dict):
                 prev_val = rec["parsed"].get("value")
             if prev_val:
-                return round(value / prev_val, 3)
+                return prev_val
         except Exception:
             continue
-    return prev
+    return None
+
+
+def _vs_prev_round(value: float) -> float:
+    prev = _prev_round_value()
+    return round(value / prev, 3) if prev else 1.0
+
+
+def _write_bench_telemetry(path, engine, state, batch, compiled_text,
+                           model_name, n_chips, b, t, peak_flops,
+                           steps=5):
+    """Telemetry sidecar for the bench record: a run_meta line (measured
+    HLO-ledger collective bytes next to the comm_report model, AOT-known
+    geometry) plus a few instrumented per-step records — written AFTER the
+    headline measurement so the per-step sync barriers cannot perturb it.
+    The JSONL renders with scripts/report_run.py; the record's
+    extra.telemetry_jsonl points here."""
+    from tiny_deepspeed_tpu.utils.hlo_comm import (
+        collective_ledger, ledger_summary,
+    )
+    from tiny_deepspeed_tpu.utils.profiling import (
+        MetricsLogger, StepTimer, comm_report,
+    )
+
+    if os.path.exists(path):
+        os.remove(path)  # one run per file: the report reads a single run
+    measured = ledger_summary(collective_ledger(compiled_text))
+    timer = StepTimer()
+    timer.watch(engine)
+    with MetricsLogger(path, stdout=False) as ml:
+        ml.log_meta(
+            engine=engine.describe(), model=model_name, devices=n_chips,
+            n_params=engine.model.num_params(), batch=b, seq_len=t,
+            tokens_per_step=b * t, peak_flops_per_chip=peak_flops,
+            comm_model=comm_report(engine), comm_measured=measured,
+        )
+        for i in range(steps):
+            with timer.step() as tm:
+                state, loss = engine.step(state, batch)
+                tm.observe(loss)
+            ml.log(i, loss=timer.last_value, step_s=timer.times[-1],
+                   tokens_per_s=b * t / max(timer.times[-1], 1e-9))
+    return state
 
 
 def main():
@@ -702,7 +776,15 @@ def main():
     except Exception as e:  # noqa: BLE001 - diagnose/retry
         _retry_or_diagnose(e)
         return
-    rec["vs_baseline"] = _vs_prev_round(rec["value"])
+    prev = _prev_round_value()
+    if prev is None:
+        # fresh cycle (trajectory []): emit the neutral baseline ratio
+        # EXPLICITLY and label it, so the driver's trajectory starts at a
+        # defined 1.0 instead of an accidental default
+        rec["vs_baseline"] = 1.0
+        rec.setdefault("extra", {})["fresh_cycle"] = True
+    else:
+        rec["vs_baseline"] = round(rec["value"] / prev, 3)
     if _default_config():
         _save_last_good(rec)
     print(json.dumps(rec))

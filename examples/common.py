@@ -208,6 +208,22 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "tokens/s) as JSONL (utils/profiling.MetricsLogger)",
     )
     p.add_argument(
+        "--telemetry", action="store_true",
+        help="full run telemetry (tiny_deepspeed_tpu/telemetry/): "
+             "on-device health metrics computed inside the compiled step "
+             "(grad/update/param norms, non-finite counts), step-time "
+             "breakdown (data wait / host->device / compute) with "
+             "recompile detection, HBM watermarks, and measured HLO-"
+             "ledger collective bytes in the run_meta record.  Pairs "
+             "with --metrics; render with scripts/report_run.py",
+    )
+    p.add_argument(
+        "--telemetry-trace", default=None, metavar="DIR",
+        help="with --telemetry: capture ONE jax.profiler trace into DIR "
+             "the first time a step exceeds 2.5x the rolling median step "
+             "time (anomaly capture; off without a directory)",
+    )
+    p.add_argument(
         "--save-every", type=int, default=0, metavar="N",
         help="write a sharded Orbax checkpoint of the TrainState every N "
              "iters into --save-dir (reference has no checkpointing, "
@@ -234,20 +250,37 @@ def parse_args(default_model="gpt2-124m", **defaults):
 def run(engine_cls, args, single_device=False):
     if getattr(args, "cpu_devices", 0):
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
-    try:
-        # persistent compile cache next to the package: re-running an entry
-        # point skips the first-step XLA compile (set JAX_CACHE_DIR to move
-        # it; harmless if the config knob is absent)
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("JAX_CACHE_DIR", os.path.join(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                ".jax_cache",
-            )),
-        )
-    except Exception:
-        pass
+        try:
+            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        except AttributeError:
+            # jax builds without the num_cpu_devices option (e.g. 0.4.37):
+            # the XLA_FLAGS env route works as long as the backend has not
+            # initialized yet, which is the case at entry-point start
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{args.cpu_devices}"
+                ).strip()
+    if not os.environ.get("TINY_DS_NO_COMPILE_CACHE"):
+        try:
+            # persistent compile cache next to the package: re-running an
+            # entry point skips the first-step XLA compile (set
+            # JAX_CACHE_DIR to move it; harmless if the config knob is
+            # absent).  TINY_DS_NO_COMPILE_CACHE=1 disables it — jaxlib
+            # 0.4.36 can SEGFAULT executing a cache-deserialized CPU
+            # executable (see tests/conftest.py), so CI example runs opt
+            # out
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get("JAX_CACHE_DIR", os.path.join(
+                    os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    ".jax_cache",
+                )),
+            )
+        except Exception:
+            pass
     init_distributed()
     import dataclasses as _dc
     model_cfg = ALL_PRESETS[args.model]
@@ -292,11 +325,18 @@ def run(engine_cls, args, single_device=False):
             if p
         ),
     )
+    telem = None
+    if getattr(args, "telemetry", False):
+        from tiny_deepspeed_tpu.telemetry import Telemetry
+        telem = Telemetry(
+            trace_dir=getattr(args, "telemetry_trace", None)
+        )
     train_kw = dict(
         grad_clip=getattr(args, "grad_clip", 0.0) or None,
         loss_scale=getattr(args, "loss_scale", None),
         offload_opt_state=getattr(args, "offload_opt_state", False),
         offload_prefetch=getattr(args, "offload_prefetch", 2),
+        telemetry=telem,
     )
     if single_device:
         engine = engine_cls(
@@ -415,19 +455,51 @@ def run(engine_cls, args, single_device=False):
         if profile_dir is not None and it == start_iter + 2:
             jax.profiler.start_trace(profile_dir)
             trace_started = True
-        idx, tgt = loader.next()
-        state, loss = engine.step(state, (jnp.asarray(idx), jnp.asarray(tgt)))
-        ran += 1
-        if rank0:
-            # device->host sync (axon-safe barrier) only where the value is
-            # consumed — other ranks run ahead and overlap loader.next()
-            # with device compute (MetricsLogger.log is rank-0 gated too)
-            loss_f = float(loss)
-            it_dt = time.perf_counter() - it_t0
+        if telem is not None and rank0:
+            # instrumented step: wall segments (data wait / host->device /
+            # compute), recompile attribution, and the health-vector sync
+            # as the closing barrier — ONE device->host transfer delivers
+            # loss + grad/update/param norms + non-finite counts.  Rank 0
+            # only: the barrier would cost the other ranks the run-ahead
+            # overlap the plain path preserves (their engine.step still
+            # pushes the aux un-synced; the compiled program is identical
+            # on every rank)
+            with telem.step() as t:
+                idx, tgt = loader.next()
+                t.mark("data")
+                batch = (jnp.asarray(idx), jnp.asarray(tgt))
+                t.mark("h2d")
+                state, loss = engine.step(state, batch)
+            ran += 1
+            health = telem.last_health
+            loss_f = (health["loss"] if health is not None
+                      else float(loss))
+            it_dt = telem.timer.times[-1]
             print(f"iter {it:3d} loss {loss_f:.4f}")
             if metrics is not None:
-                metrics.log(it, loss=loss_f, step_s=it_dt,
-                            tokens_per_s=b * args.seq_len / max(it_dt, 1e-9))
+                metrics.log(
+                    it, loss=loss_f, step_s=it_dt,
+                    tokens_per_s=b * args.seq_len / max(it_dt, 1e-9),
+                    **telem.step_record(),
+                )
+        else:
+            idx, tgt = loader.next()
+            state, loss = engine.step(
+                state, (jnp.asarray(idx), jnp.asarray(tgt))
+            )
+            ran += 1
+            if rank0:
+                # device->host sync (axon-safe barrier) only where the
+                # value is consumed — other ranks run ahead and overlap
+                # loader.next() with device compute (MetricsLogger.log is
+                # rank-0 gated too)
+                loss_f = float(loss)
+                it_dt = time.perf_counter() - it_t0
+                print(f"iter {it:3d} loss {loss_f:.4f}")
+                if metrics is not None:
+                    metrics.log(it, loss=loss_f, step_s=it_dt,
+                                tokens_per_s=b * args.seq_len
+                                / max(it_dt, 1e-9))
         if trace_started and it == start_iter + 4:
             jax.profiler.stop_trace()
             trace_started = False
@@ -458,6 +530,22 @@ def run(engine_cls, args, single_device=False):
     loader.close()
     if val_loader is not None:
         val_loader.close()
+    if telem is not None and metrics is not None:
+        if jax.process_count() == 1 and ran:
+            # run_meta: measured collective ledger off the compiled step's
+            # HLO (single-controller only — a one-host AOT compile of a
+            # multi-host program would diverge) next to the comm_report
+            # ring model.  Captured AFTER the loop: the AOT compile is a
+            # second full compile of the step program (the jit dispatch
+            # cache is separate), so doing it up front would double
+            # time-to-first-step on big models
+            probe = jnp.zeros((b, args.seq_len), jnp.int32)
+            metrics.log_meta(**telem.run_meta(
+                state, (probe, probe), model=args.model,
+                n_params=model.num_params(), batch=b,
+                seq_len=args.seq_len, tokens_per_step=b * args.seq_len,
+            ))
+        telem.flush(metrics)  # registry snapshot -> telemetry_summary record
     if metrics is not None:
         metrics.close()
     dt = time.perf_counter() - t0
@@ -465,4 +553,12 @@ def run(engine_cls, args, single_device=False):
         toks = ran * b * args.seq_len
         print(f"done: {ran} iters in {dt:.1f}s "
               f"({toks / dt:.0f} tokens/s)")
+        if telem is not None and telem.timer.times:
+            tm = telem.timer
+            print(f"step time p50 {tm.p50_s * 1e3:.1f}ms "
+                  f"p95 {tm.p95_s * 1e3:.1f}ms; "
+                  f"compiles {tm.compile_count}")
+            if getattr(args, "metrics", None):
+                print("run report: python scripts/report_run.py "
+                      f"{args.metrics}")
     return state

@@ -1,0 +1,343 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Render a training run's metrics JSONL into a markdown dashboard — or
+validate it against the telemetry schema.
+
+    python scripts/report_run.py RUN.jsonl [-o REPORT.md]
+    python scripts/report_run.py --check RUN.jsonl
+
+The JSONL comes from `utils.profiling.MetricsLogger` (examples/common.py
+`--telemetry --metrics RUN.jsonl`, or bench.py's telemetry sidecar); the
+schema is `tiny_deepspeed_tpu/telemetry/schema.py`.  `--check` exits
+non-zero on any drift (unknown fields, wrong types, malformed lines) so CI
+catches schema breakage (tests/test_telemetry.py smoke-runs it in tier-1).
+
+The report covers: throughput (p50/p95 step time, tokens/s, MFU when the
+meta record carries FLOPs context), the step-time breakdown (data-wait vs
+host->device vs device compute), measured (HLO-ledger) collective bytes
+next to the `comm_report` ring model, HBM watermarks vs the AOT prediction,
+and health flags (non-finite grads, loss spikes, recompiles, anomaly
+traces).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tiny_deepspeed_tpu.telemetry import schema  # noqa: E402
+from tiny_deepspeed_tpu.utils.profiling import _quantile  # noqa: E402
+
+
+def load_run(path: str) -> Tuple[List[dict], List[dict], List[str]]:
+    """(meta records, step records, parse errors) from a metrics JSONL."""
+    metas, steps, errs = [], [], []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errs.append(f"line {i}: invalid JSON ({e})")
+                continue
+            (metas if isinstance(rec, dict) and "kind" in rec
+             else steps).append(rec)
+    return metas, steps, errs
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, div in (("GB", 2 ** 30), ("MB", 2 ** 20), ("KB", 2 ** 10)):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n:.0f} B"
+
+
+def _col(steps: List[dict], key: str) -> List[float]:
+    return [
+        r[key] for r in steps
+        if isinstance(r.get(key), (int, float))
+        and not isinstance(r.get(key), bool)
+        and math.isfinite(r[key])
+    ]
+
+
+def _meta(metas: List[dict], kind: str) -> Optional[dict]:
+    for m in metas:
+        if m.get("kind") == kind:
+            return m
+    return None
+
+
+def render_report(metas: List[dict], steps: List[dict],
+                  source: str = "") -> str:
+    run = _meta(metas, "run_meta") or {}
+    summary = _meta(metas, "telemetry_summary") or {}
+    out: List[str] = []
+    title = run.get("model") or os.path.basename(source) or "training run"
+    out.append(f"# Run report — {title}\n")
+    if source:
+        out.append(f"Source: `{source}`\n")
+
+    # -- run identity -------------------------------------------------------
+    if run:
+        out.append("## Run\n")
+        for label, key in (("engine", "engine"), ("devices", "devices"),
+                           ("params", "n_params"), ("batch", "batch"),
+                           ("seq len", "seq_len"),
+                           ("tokens/step", "tokens_per_step")):
+            if key in run:
+                v = run[key]
+                if key == "n_params":
+                    v = f"{v / 1e6:.1f}M"
+                out.append(f"- {label}: {v}")
+        out.append("")
+
+    # -- throughput ---------------------------------------------------------
+    times = _col(steps, "step_s")
+    # drop the first step once there are more: it pays the compile
+    warm = times[1:] if len(times) > 1 else times
+    toks = _col(steps, "tokens_per_s")
+    out.append("## Throughput\n")
+    out.append(f"- steps recorded: {len(steps)}")
+    if warm:
+        out.append(
+            f"- step time: mean {sum(warm) / len(warm) * 1e3:.1f} ms, "
+            f"p50 {_quantile(warm, 0.5) * 1e3:.1f} ms, "
+            f"p95 {_quantile(warm, 0.95) * 1e3:.1f} ms"
+        )
+    if toks:
+        warm_toks = toks[1:] if len(toks) > 1 else toks
+        mean_tps = sum(warm_toks) / len(warm_toks)
+        out.append(f"- tokens/s: mean {mean_tps:,.0f}")
+        peak = run.get("peak_flops_per_chip")
+        n_params = run.get("n_params")
+        devices = run.get("devices", 1) or 1
+        if peak and n_params:
+            mfu = 6 * n_params * mean_tps / devices / peak
+            out.append(f"- MFU (6N): {mfu:.3f}")
+    out.append("")
+
+    # -- step-time breakdown ------------------------------------------------
+    seg_keys = [k for k in ("data_s", "h2d_s", "compute_s")
+                if _col(steps, k)]
+    if seg_keys:
+        out.append("## Step-time breakdown (mean, share of step)\n")
+        out.append("| segment | mean | share |")
+        out.append("|---|---|---|")
+        total = sum(
+            sum(_col(steps, k)) / max(1, len(_col(steps, k)))
+            for k in seg_keys
+        )
+        names = {"data_s": "data wait", "h2d_s": "host->device",
+                 "compute_s": "device compute (+sync)"}
+        for k in seg_keys:
+            xs = _col(steps, k)
+            mean = sum(xs) / len(xs)
+            share = mean / total if total else 0.0
+            out.append(
+                f"| {names[k]} | {mean * 1e3:.2f} ms | {share:.0%} |"
+            )
+        out.append("")
+
+    # -- communication ------------------------------------------------------
+    measured = run.get("comm_measured")
+    model_rep = run.get("comm_model")
+    if measured or model_rep:
+        out.append("## Collective traffic (per device per step)\n")
+        if model_rep:
+            out.append("ring-model prediction (`comm_report`):\n")
+            for k, v in sorted(model_rep.items()):
+                if k.endswith("_bytes") and v:
+                    out.append(f"- {k}: {_fmt_bytes(v)}")
+            out.append("")
+        if measured:
+            out.append("measured from the compiled step's HLO ledger "
+                       "(`utils/hlo_comm.py`):\n")
+            out.append("| collective | wire bytes | ops/step |")
+            out.append("|---|---|---|")
+            counts = measured.get("count", {})
+            for op, v in sorted(measured.get("wire_bytes", {}).items()):
+                out.append(
+                    f"| {op} | {_fmt_bytes(v)} | "
+                    f"{counts.get(op, 0):.0f} |"
+                )
+            out.append(
+                f"| **total** | **{_fmt_bytes(measured['total_wire_bytes'])}"
+                f"** | |"
+            )
+            out.append("")
+            if "comm_delta" in run:
+                out.append(
+                    f"measured / modeled = **{run['comm_delta']:.3f}** "
+                    "(1.0 = the ring model is exact; >1 = the partitioner "
+                    "emitted more wire traffic than the model predicts)\n"
+                )
+            unresolved = (measured.get("unresolved_loops", 0)
+                          + measured.get("unresolved_groups", 0))
+            if unresolved:
+                out.append(
+                    f"WARNING: {unresolved} collective(s)/loop(s) had "
+                    "unresolved attribution — totals are a lower bound\n"
+                )
+
+    # -- memory -------------------------------------------------------------
+    hbm_peak = _col(steps, "hbm_gb_peak")
+    aot = run.get("aot") or {}
+    if hbm_peak or aot:
+        out.append("## Memory\n")
+        if hbm_peak:
+            out.append(
+                f"- HBM peak watermark: {max(hbm_peak):.3f} GB "
+                f"(first step {hbm_peak[0]:.3f} GB)"
+            )
+            in_use = _col(steps, "hbm_gb_in_use")
+            if in_use:
+                out.append(f"- HBM in use (last step): {in_use[-1]:.3f} GB")
+        if aot.get("temp_bytes") is not None:
+            out.append(
+                f"- AOT-predicted step temp: "
+                f"{_fmt_bytes(aot['temp_bytes'])}"
+            )
+            if hbm_peak:
+                pred_gb = aot["temp_bytes"] / 2 ** 30
+                out.append(
+                    f"- predicted-vs-measured delta: "
+                    f"{max(hbm_peak) - pred_gb:+.3f} GB "
+                    "(live state + allocator slack)"
+                )
+        out.append("")
+
+    # -- health -------------------------------------------------------------
+    out.append("## Health\n")
+    flags = []
+    losses = _col(steps, "loss")
+    if losses:
+        out.append(
+            f"- loss: first {losses[0]:.4f} -> last {losses[-1]:.4f} "
+            f"(min {min(losses):.4f})"
+        )
+        if losses[-1] > losses[0]:
+            flags.append("loss ended ABOVE its starting value")
+    gn = _col(steps, "grad_norm")
+    if gn:
+        out.append(f"- grad norm: max {max(gn):.4f}, last {gn[-1]:.4f}")
+        p50_gn = _quantile(gn, 0.5)
+        if p50_gn and max(gn) > 10 * p50_gn:
+            flags.append(
+                f"grad-norm spike: max {max(gn):.3g} vs p50 {p50_gn:.3g}"
+            )
+    nf = [r for r in steps if r.get("nonfinite_grads")]
+    if nf:
+        flags.append(
+            f"{len(nf)} step(s) with NON-FINITE gradients "
+            f"(first at step {nf[0].get('step')})"
+        )
+    else:
+        nonf = _col(steps, "nonfinite_grads")
+        if nonf:
+            out.append("- non-finite grads: none")
+    # the first recorded step legitimately pays the first compile; any
+    # compiled>0 after it is a shape-driven recompile worth flagging
+    recompiles = [r for r in steps[1:] if r.get("compiled")]
+    if recompiles:
+        flags.append(
+            f"{len(recompiles)} RECOMPILE step(s) beyond the first "
+            f"(steps {[r.get('step') for r in recompiles][:8]})"
+        )
+    traces = [r["anomaly_trace"] for r in steps if r.get("anomaly_trace")]
+    if traces:
+        flags.append(f"anomaly trace captured: `{traces[0]}`")
+    if warm:
+        p50 = _quantile(warm, 0.5)
+        slow = [t for t in warm if p50 and t > 2 * p50]
+        if slow:
+            flags.append(
+                f"{len(slow)} step(s) slower than 2x the p50 step time"
+            )
+    if flags:
+        out.append("\n### Flags\n")
+        for fl in flags:
+            out.append(f"- [!] {fl}")
+    else:
+        out.append("- no flags raised")
+    out.append("")
+
+    # -- telemetry registry summary ----------------------------------------
+    if summary:
+        out.append("## Telemetry registry\n")
+        counters = summary.get("counters") or {}
+        if counters:
+            out.append("counters: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(counters.items())
+            ) + "\n")
+        hists = summary.get("histograms") or {}
+        if hists:
+            out.append("| histogram | count | mean | p50 | p95 | max |")
+            out.append("|---|---|---|---|---|---|")
+            for k, h in sorted(hists.items()):
+                out.append(
+                    f"| {k} | {h.get('count', 0)} | {h.get('mean', 0):.4g} "
+                    f"| {h.get('p50', 0):.4g} | {h.get('p95', 0):.4g} "
+                    f"| {h.get('max', 0):.4g} |"
+                )
+            out.append("")
+    return "\n".join(out) + "\n"
+
+
+def check(path: str) -> int:
+    counts, errs = schema.validate_file(path)
+    for e in errs:
+        print(f"{path}: {e}", file=sys.stderr)
+    if errs:
+        print(
+            f"{path}: SCHEMA DRIFT — {len(errs)} error(s) "
+            f"({counts['step']} valid step + {counts['meta']} valid meta "
+            "records)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"{path}: ok — {counts['step']} step record(s), "
+        f"{counts['meta']} meta record(s)"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="metrics JSONL from a training run")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the markdown report here (default: stdout)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the schema instead of rendering; "
+                         "exit non-zero on drift")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.jsonl):
+        print(f"{args.jsonl}: no such file", file=sys.stderr)
+        return 2
+    if args.check:
+        return check(args.jsonl)
+    metas, steps, errs = load_run(args.jsonl)
+    for e in errs:
+        print(f"warning: {e}", file=sys.stderr)
+    report = render_report(metas, steps, source=args.jsonl)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        print(f"wrote {args.out}")
+    else:
+        print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -223,6 +223,7 @@ class ZeroEngine:
         loss_scale_growth_interval: int = 2000,
         offload_opt_state: bool = False,
         offload_prefetch: int = 2,
+        telemetry=None,
     ):
         """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
         tokens shard over it and attention runs as a ppermute ring
@@ -257,6 +258,17 @@ class ZeroEngine:
         fp16 AMP (the reference's unchecked TODO, reference README.md:68):
         bf16 — the TPU default policy — never needs it, fp16
         (compute_dtype=float16) does.
+
+        telemetry: opt-in in-step observability (a
+        `tiny_deepspeed_tpu.telemetry.Telemetry` instance, or any object
+        with `on_step_output(aux)`).  When set, the compiled step also
+        computes the packed on-device health vector (loss, grad/update/
+        param global norms, non-finite grad count — telemetry/health.py)
+        and `step()` pushes it into the telemetry object WITHOUT syncing;
+        the vector rides the step output, so reading it costs the same
+        single device->host transfer as reading the loss.  With
+        telemetry=None (the default) the step program is byte-identical
+        to an un-knobbed engine (tests/test_telemetry.py pins the HLO).
 
         offload_opt_state: ZeRO-Offload-style placement — optimizer
         moments REST in host memory (NamedSharding memory_kind
@@ -544,6 +556,13 @@ class ZeroEngine:
             NamedSharding(mesh, P()) if self._dropout_active else None
         )
 
+        # opt-in telemetry: the health vector is part of the compiled step
+        # output, so the flag must be settled before _build_step traces
+        self.telemetry = telemetry
+        self._telemetry_on = telemetry is not None
+        if self._telemetry_on and hasattr(telemetry, "attach"):
+            telemetry.attach(self)
+
         if self.data_parallel:
             batch_spec = P("data", self.seq_axis)  # (B, T): tokens shard too
         else:
@@ -596,6 +615,11 @@ class ZeroEngine:
                     dropout_base=self._dropout_shardings,
                 ),
                 NamedSharding(self.mesh, P()),
+            ) + (
+                # telemetry: the packed (5,) health vector rides along,
+                # replicated like the loss
+                (NamedSharding(self.mesh, P()),) if self._telemetry_on
+                else ()
             ),
             donate_argnums=(0,),
         )
@@ -901,15 +925,27 @@ class ZeroEngine:
         # they stay sharded.  (The reference broadcasts per-param from the
         # owner in a python loop with no bucketing, zero1/optim.py:25-34.)
         new_params = self._constrain(new_params, self._param_shardings)
-        return (
-            TrainState(params=new_params, opt_state=new_opt,
-                       scaler=new_scaler, dropout_base=state.dropout_base),
-            loss,
-        )
+        new_state = TrainState(params=new_params, opt_state=new_opt,
+                               scaler=new_scaler,
+                               dropout_base=state.dropout_base)
+        if self._telemetry_on:
+            # on-device health metrics, packed into one (5,) vector: the
+            # norms run over the logical (sharded) grads/params, so XLA
+            # inserts the cross-shard psum and the numbers are global
+            from ..telemetry.health import health_vector
+            aux = health_vector(loss, grads, params, new_params)
+            return new_state, loss, aux
+        return new_state, loss
 
     def step(self, state, batch):
         """One optimizer step.  batch = (idx, targets), each (B, T) int32 —
-        or (accum, B, T) when accum_steps > 1."""
+        or (accum, B, T) when accum_steps > 1.  Returns (state, loss)
+        either way; with the telemetry knob the step's packed health
+        vector is pushed into the telemetry object un-synced."""
+        if self._telemetry_on:
+            state, loss, aux = self._step(state, batch)
+            self.telemetry.on_step_output(aux)
+            return state, loss
         return self._step(state, batch)
 
     def eval_loss(self, state, batch):
@@ -939,6 +975,8 @@ class ZeroEngine:
             extras += f", loss_scale={self.loss_scale}"
         if self.offload_opt_state:
             extras += ", opt state offloaded=pinned_host"
+        if self._telemetry_on:
+            extras += ", telemetry=on"
         return (
             f"{name}(stage={self.stage}, devices={self.n_dev}, "
             f"accum={self.accum_steps}, params sharded="

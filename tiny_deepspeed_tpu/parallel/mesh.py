@@ -41,8 +41,16 @@ def init_distributed(**kwargs) -> None:
     initialization — jax.distributed.initialize would otherwise block
     waiting for a coordinator.
     """
-    if jax.distributed.is_initialized():
-        return
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        if is_init():
+            return
+    else:
+        # jax builds without the predicate (e.g. 0.4.37): the global state
+        # object's client is the same signal
+        state = getattr(jax._src.distributed, "global_state", None)
+        if state is not None and getattr(state, "client", None) is not None:
+            return
     multi_host_env = any(
         os.environ.get(v)
         for v in (

@@ -1,0 +1,39 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""In-step training telemetry: the run-observability subsystem.
+
+The reference's entire observability surface is a wall-clock timer and
+rank-0 loss prints (SURVEY §2.8, utils/profiling.py docstring).  This
+package instruments a training run end to end:
+
+  * `health` — on-device health metrics (grad/update/param global norms,
+    non-finite counts, loss) computed INSIDE the compiled step and returned
+    as one small auxiliary vector, so they ride the existing step output
+    with zero extra host syncs.  Wired into `ZeroEngine` behind the opt-in
+    `telemetry=` engine knob; with `telemetry=None` the compiled step is
+    byte-identical (tests/test_telemetry.py pins the HLO).
+  * `Telemetry` (registry.py) — counters / gauges / histograms, the
+    step-time breakdown wrapper (data-wait vs host-to-device vs device
+    compute, recompile detection), measured collective gauges from the
+    compiled step's HLO ledger (utils/hlo_comm.py), per-step HBM watermarks
+    from device memory stats, and an anomaly-triggered `jax.profiler`
+    trace capture (one xprof trace when step time exceeds a rolling
+    threshold).
+  * `schema` — the JSONL metrics schema shared with
+    `utils.profiling.MetricsLogger`; `scripts/report_run.py --check`
+    validates files against it and `scripts/report_run.py RUN.jsonl`
+    renders the markdown run report.
+"""
+
+from .health import HEALTH_FIELDS, health_dict, health_vector
+from .registry import Telemetry
+from . import schema
+
+__all__ = [
+    "HEALTH_FIELDS",
+    "health_vector",
+    "health_dict",
+    "Telemetry",
+    "schema",
+]

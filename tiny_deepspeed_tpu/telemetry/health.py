@@ -1,0 +1,70 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""On-device training-health metrics, computed inside the compiled step.
+
+`health_vector` runs in the engine's jitted `_step_body` (behind the
+`telemetry=` knob) and packs everything into ONE (5,) f32 vector so the
+whole health tree costs a single device->host transfer when read — the
+same cost as reading the loss alone, whose value rides at element 0.
+
+All norms are GLOBAL: the sums of squares run over the logical arrays, so
+under ZeRO-2/3 sharded grads/params XLA inserts the cross-shard psum and
+every rank sees the same numbers (tests/test_telemetry.py checks them
+against an independent single-device recompute per stage).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+# element order of the packed vector; "loss" MUST stay first — StepTimer's
+# sync barrier reads element 0 as the step's loss value
+HEALTH_FIELDS = (
+    "loss", "grad_norm", "update_norm", "param_norm", "nonfinite_grads",
+)
+
+
+def _sq_sum(tree):
+    return sum(
+        jnp.sum(jnp.square(x.astype(jnp.float32)))
+        for x in jax.tree.leaves(tree)
+    )
+
+
+def health_vector(loss, grads, params, new_params) -> jax.Array:
+    """(5,) f32: [loss, grad L2 norm, update L2 norm, new-param L2 norm,
+    non-finite grad element count].  Traced inside the step; all inputs are
+    the step's own intermediates, so nothing extra crosses the host
+    boundary."""
+    gsq = _sq_sum(grads)
+    usq = sum(
+        jnp.sum(jnp.square(
+            n.astype(jnp.float32) - o.astype(jnp.float32)
+        ))
+        for n, o in zip(
+            jax.tree.leaves(new_params), jax.tree.leaves(params)
+        )
+    )
+    psq = _sq_sum(new_params)
+    bad = sum(
+        jnp.sum((~jnp.isfinite(g.astype(jnp.float32))).astype(jnp.float32))
+        for g in jax.tree.leaves(grads)
+    )
+    return jnp.stack([
+        jnp.asarray(loss, jnp.float32).reshape(()),
+        jnp.sqrt(gsq), jnp.sqrt(usq), jnp.sqrt(psq), bad,
+    ])
+
+
+def health_dict(vec) -> Dict[str, float]:
+    """Host-side unpack of a (5,) health vector (device array or numpy)."""
+    import numpy as np
+
+    vals = np.asarray(vec).ravel()
+    out = {k: float(v) for k, v in zip(HEALTH_FIELDS, vals)}
+    out["nonfinite_grads"] = int(out["nonfinite_grads"])
+    return out

@@ -1,0 +1,352 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""The `Telemetry` registry: counters/gauges/histograms, the instrumented
+step wrapper, measured collective/memory gauges, and the anomaly tracer.
+
+One object owns a run's telemetry:
+
+    telem = Telemetry(trace_dir="traces")          # anomaly xprof capture
+    eng   = Zero2(model, opt, telemetry=telem)     # health vector in-step
+    ...
+    with telem.step() as t:                        # timing + breakdown
+        idx, tgt = loader.next();  t.mark("data")
+        batch = device_put(...);   t.mark("h2d")
+        state, loss = eng.step(state, batch)       # engine pushes the aux
+    metrics.log(it, loss=telem.last_health["loss"], **telem.step_record())
+
+The engine's health vector is observed as the step's sync barrier, so the
+ONE device->host transfer that closes the step clock also delivers loss +
+grad/update/param norms + non-finite counts — telemetry-on adds no
+additional transfers per step over reading the loss alone.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+
+from .health import HEALTH_FIELDS, health_dict
+from ..utils.profiling import StepTimer, comm_report, _quantile
+
+_GB = float(2 ** 30)
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> int:
+        self.value += n
+        return self.value
+
+
+class Histogram:
+    __slots__ = ("values",)
+
+    def __init__(self):
+        self.values = []
+
+    def observe(self, v: float) -> None:
+        self.values.append(float(v))
+
+    @property
+    def count(self) -> int:
+        return len(self.values)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.values) / max(1, len(self.values))
+
+    @property
+    def p50(self) -> float:
+        return _quantile(self.values, 0.50)
+
+    @property
+    def p95(self) -> float:
+        return _quantile(self.values, 0.95)
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "p50": self.p50,
+            "p95": self.p95,
+            "max": max(self.values) if self.values else 0.0,
+        }
+
+
+class Telemetry:
+    """Run-level telemetry registry + step instrumentation.
+
+    anomaly capture: after `anomaly_min_steps` samples, a step slower than
+    `anomaly_factor` x the rolling median ARMS the tracer; the next
+    `telem.step()` runs under `jax.profiler` and writes ONE xprof trace
+    into `trace_dir` — then never again this run (first anomalies are the
+    interesting ones; a pathological run must not fill the disk with
+    traces).  `tracer=(start_fn, stop_fn)` injects a fake pair for tests.
+    """
+
+    def __init__(
+        self,
+        timer: Optional[StepTimer] = None,
+        trace_dir: Optional[str] = None,
+        anomaly_factor: float = 2.5,
+        anomaly_min_steps: int = 10,
+        anomaly_window: int = 50,
+        tracer=None,
+    ):
+        self.timer = timer or StepTimer()
+        self.timer.fetch_full = True
+        self.trace_dir = trace_dir
+        self.anomaly_factor = float(anomaly_factor)
+        self.anomaly_min_steps = int(anomaly_min_steps)
+        self.anomaly_window = int(anomaly_window)
+        self._tracer = tracer or (
+            jax.profiler.start_trace, jax.profiler.stop_trace,
+        )
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, Histogram] = {}
+        self._engine = None
+        self._last_aux = None
+        self._last_health = None
+        self._recent = []
+        self._trace_armed = False
+        self._trace_fired = False
+        self.trace_path: Optional[str] = None
+        self._trace_logged = False
+        self._comm: Optional[Dict[str, object]] = None
+
+    # -- registry -----------------------------------------------------------
+
+    def counter(self, name: str) -> Counter:
+        return self.counters.setdefault(name, Counter())
+
+    def gauge(self, name: str, value=None):
+        if value is not None:
+            self.gauges[name] = float(value)
+        return self.gauges.get(name)
+
+    def histogram(self, name: str) -> Histogram:
+        return self.histograms.setdefault(name, Histogram())
+
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-safe registry dump for the `telemetry_summary` record."""
+        return {
+            "counters": {k: c.value for k, c in self.counters.items()},
+            "gauges": dict(self.gauges),
+            "histograms": {
+                k: h.snapshot() for k, h in self.histograms.items()
+            },
+        }
+
+    # -- engine wiring ------------------------------------------------------
+
+    def attach(self, engine) -> None:
+        """Called by `ZeroEngine.__init__(telemetry=...)`: watch the
+        engine's jitted step for (re)compile counting and remember it for
+        `capture_compiled`."""
+        self._engine = engine
+        self.timer.watch(engine)
+
+    def on_step_output(self, aux) -> None:
+        """Engine push: the step's packed health vector (device array, NOT
+        synced here)."""
+        self._last_aux = aux
+        self._last_health = None
+
+    def poll(self) -> Optional[Dict[str, float]]:
+        """Host view of the latest health vector (one transfer, cached)."""
+        if self._last_health is None and self._last_aux is not None:
+            self._last_health = health_dict(np.asarray(self._last_aux))
+        return self._last_health
+
+    @property
+    def last_health(self) -> Optional[Dict[str, float]]:
+        return self.poll()
+
+    # -- the instrumented step ----------------------------------------------
+
+    @contextlib.contextmanager
+    def step(self):
+        """Wrap one training step: timing + segment marks via the inner
+        StepTimer handle, health-vector sync as the closing barrier, and
+        the armed anomaly trace if one is pending."""
+        trace_now = (
+            self._trace_armed and not self._trace_fired
+            and self.trace_dir is not None
+        )
+        if trace_now:
+            path = os.path.join(self.trace_dir, "anomaly")
+            os.makedirs(path, exist_ok=True)
+            self._tracer[0](path)
+        try:
+            with self.timer.step() as t:
+                yield t
+                if self._last_aux is not None:
+                    t.observe(self._last_aux)
+        finally:
+            if trace_now:
+                self._tracer[1]()
+                self._trace_fired = True
+                self._trace_armed = False
+                self.trace_path = path
+                self.counter("anomaly_traces").inc()
+        # -- success-path bookkeeping (an exception skips all of it) --
+        host = self.timer.last_host
+        if host is not None and len(host) == len(HEALTH_FIELDS):
+            self._last_health = health_dict(host)
+        dt = self.timer.times[-1]
+        self.counter("steps").inc()
+        self.histogram("step_s").observe(dt)
+        if self.timer.segments:
+            for k, v in self.timer.segments[-1].items():
+                self.histogram(k).observe(v)
+        if self.timer.compiled_steps[-1]:
+            self.counter("compiles").inc(self.timer.compiled_steps[-1])
+        self.note_step_time(dt)
+
+    def note_step_time(self, s: float) -> bool:
+        """Feed one step wall time to the anomaly detector.  Returns True
+        exactly once per run: the first time a step exceeds
+        `anomaly_factor` x the rolling median (after the warmup window)."""
+        fired = False
+        if (
+            len(self._recent) >= self.anomaly_min_steps
+            and not self._trace_armed and not self._trace_fired
+        ):
+            med = _quantile(self._recent, 0.5)
+            if s > self.anomaly_factor * med:
+                self._trace_armed = True
+                self.counter("anomalies").inc()
+                self.gauge("anomaly_step_s", s)
+                self.gauge("anomaly_threshold_s", self.anomaly_factor * med)
+                fired = True
+        self._recent.append(float(s))
+        if len(self._recent) > self.anomaly_window:
+            self._recent.pop(0)
+        return fired
+
+    # -- measured gauges ----------------------------------------------------
+
+    def sample_memory(self) -> Dict[str, float]:
+        """Per-step HBM watermark from device memory stats (TPU runtime;
+        the CPU backend reports none and this returns {})."""
+        in_use = peak = 0
+        seen = False
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            seen = True
+            in_use = max(in_use, int(stats.get("bytes_in_use", 0)))
+            peak = max(peak, int(stats.get(
+                "peak_bytes_in_use", stats.get("bytes_in_use", 0)
+            )))
+        if not seen:
+            return {}
+        out = {
+            "hbm_gb_in_use": round(in_use / _GB, 4),
+            "hbm_gb_peak": round(peak / _GB, 4),
+        }
+        self.gauge("hbm_gb_in_use", out["hbm_gb_in_use"])
+        self.gauge(
+            "hbm_gb_peak",
+            max(self.gauge("hbm_gb_peak") or 0.0, out["hbm_gb_peak"]),
+        )
+        return out
+
+    def capture_compiled(self, state, batch, engine=None):
+        """Measured collective gauges: compile the engine's step for
+        (state, batch) and read the REAL collective ledger off the post-
+        SPMD HLO (utils/hlo_comm.py), next to the ring-model `comm_report`
+        prediction — plus the AOT memory analysis when the backend
+        provides one."""
+        from ..utils.hlo_comm import collective_ledger, ledger_summary
+
+        engine = engine or self._engine
+        if engine is None:
+            raise ValueError("no engine attached; pass engine=")
+        compiled = engine._step.lower(state, batch).compile()
+        measured = ledger_summary(collective_ledger(compiled.as_text()))
+        model_rep = comm_report(engine)
+        out: Dict[str, object] = {
+            "comm_measured": measured,
+            "comm_model": model_rep,
+        }
+        modeled = float(model_rep.get("total_bytes_per_step", 0.0))
+        if modeled > 0:
+            out["comm_delta"] = round(
+                measured["total_wire_bytes"] / modeled, 4
+            )
+        self.gauge("measured_wire_bytes", measured["total_wire_bytes"])
+        self.gauge("modeled_wire_bytes", modeled)
+        try:
+            mem = compiled.memory_analysis()
+            out["aot"] = {
+                "temp_bytes": int(mem.temp_size_in_bytes),
+                "argument_bytes": int(mem.argument_size_in_bytes),
+                "output_bytes": int(mem.output_size_in_bytes),
+            }
+            self.gauge("aot_temp_bytes", mem.temp_size_in_bytes)
+        except Exception:
+            pass
+        self._comm = out
+        return out
+
+    def run_meta(self, state, sample_batch, engine=None, **extra):
+        """Assemble the run_meta record: engine identity + comm gauges +
+        caller extras (model name, n_params, batch geometry, ...).
+        `sample_batch` only provides shapes for the AOT lowering."""
+        engine = engine or self._engine
+        meta: Dict[str, object] = {}
+        try:
+            meta.update(self.capture_compiled(
+                state, sample_batch, engine=engine,
+            ))
+        except Exception as e:  # CPU backends missing pieces stay best-effort
+            meta["comm_error"] = repr(e)[:200]
+        if engine is not None:
+            meta.update(
+                engine=engine.describe(),
+                stage=engine.stage,
+                devices=engine.n_dev,
+            )
+        meta.update(extra)
+        return meta
+
+    # -- sinks --------------------------------------------------------------
+
+    def step_record(self) -> Dict[str, object]:
+        """Per-step JSONL fields beyond loss/step_s/tokens_per_s: health,
+        wall-segment breakdown, compile attribution, HBM watermarks, and
+        (once) the anomaly trace path."""
+        rec: Dict[str, object] = {}
+        h = self.poll()
+        if h is not None:
+            rec.update({k: h[k] for k in HEALTH_FIELDS if k != "loss"})
+        if self.timer.segments:
+            rec.update(self.timer.segments[-1])
+        if self.timer.compiled_steps:
+            rec["compiled"] = int(self.timer.compiled_steps[-1])
+        rec.update(self.sample_memory())
+        if self.trace_path and not self._trace_logged:
+            rec["anomaly_trace"] = self.trace_path
+            self._trace_logged = True
+        return rec
+
+    def flush(self, logger) -> None:
+        """Write the registry snapshot as a `telemetry_summary` record to a
+        MetricsLogger (no-op without a JSONL sink)."""
+        logger.log_meta(kind="telemetry_summary", **self.snapshot())

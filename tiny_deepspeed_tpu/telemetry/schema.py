@@ -1,0 +1,138 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""The JSONL metrics schema: one source of truth for what a run's metrics
+file may contain.
+
+Two record kinds share a file:
+
+  * step records   — `MetricsLogger.log(step, **fields)`:
+                     {"step": int, "ts": float, ...optional fields}
+  * meta records   — `MetricsLogger.log_meta(kind=..., **fields)`:
+                     {"kind": "run_meta"|"telemetry_summary", "ts": float,
+                      ...optional fields}
+
+`scripts/report_run.py --check` validates a file against this module and
+exits non-zero on drift (unknown fields, wrong types, missing requireds),
+so adding a metric means adding it HERE deliberately — that is what makes
+the check catch accidental schema breakage in CI (tests/test_telemetry.py
+smoke-runs it in tier-1).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+_NUM = (int, float)
+
+# step-record fields beyond the required step/ts; values are allowed types
+STEP_FIELDS: Dict[str, tuple] = {
+    "loss": _NUM,
+    "step_s": _NUM,
+    "tokens_per_s": _NUM,
+    "val_loss": _NUM,
+    # on-device health vector (telemetry/health.py)
+    "grad_norm": _NUM,
+    "update_norm": _NUM,
+    "param_norm": _NUM,
+    "nonfinite_grads": _NUM,
+    # wall-segment breakdown (StepTimer.mark)
+    "data_s": _NUM,
+    "h2d_s": _NUM,
+    "compute_s": _NUM,
+    # lowerings paid by this step (first compile / recompile attribution)
+    "compiled": int,
+    # HBM watermarks (Telemetry.sample_memory; TPU runtime only)
+    "hbm_gb_in_use": _NUM,
+    "hbm_gb_peak": _NUM,
+    # one-shot anomaly xprof capture location
+    "anomaly_trace": str,
+}
+
+META_KINDS = ("run_meta", "telemetry_summary")
+
+META_FIELDS: Dict[str, tuple] = {
+    "engine": str,
+    "stage": int,
+    "devices": int,
+    "model": str,
+    "n_params": _NUM,
+    "tokens_per_step": _NUM,
+    "batch": int,
+    "seq_len": int,
+    "peak_flops_per_chip": _NUM,
+    # measured-vs-modeled collective traffic (Telemetry.capture_compiled)
+    "comm_model": dict,
+    "comm_measured": dict,
+    "comm_delta": _NUM,
+    "comm_error": str,
+    "aot": dict,
+    # registry snapshot (Telemetry.flush)
+    "counters": dict,
+    "gauges": dict,
+    "histograms": dict,
+}
+
+
+def validate_record(rec) -> List[str]:
+    """Schema errors for one parsed JSONL record ([] = valid)."""
+    if not isinstance(rec, dict):
+        return ["record is not a JSON object"]
+    errs: List[str] = []
+    if "kind" in rec:
+        kind = rec["kind"]
+        if kind not in META_KINDS:
+            errs.append(f"unknown meta kind {kind!r}")
+        if not isinstance(rec.get("ts"), _NUM):
+            errs.append("meta record missing numeric 'ts'")
+        for k, v in rec.items():
+            if k in ("kind", "ts"):
+                continue
+            if k not in META_FIELDS:
+                errs.append(f"unknown meta field {k!r}")
+            elif not isinstance(v, META_FIELDS[k]):
+                errs.append(
+                    f"meta field {k!r}: expected "
+                    f"{META_FIELDS[k]}, got {type(v).__name__}"
+                )
+        return errs
+    # step record
+    if not isinstance(rec.get("step"), int) \
+            or isinstance(rec.get("step"), bool):
+        errs.append("step record missing integer 'step'")
+    if not isinstance(rec.get("ts"), _NUM):
+        errs.append("step record missing numeric 'ts'")
+    for k, v in rec.items():
+        if k in ("step", "ts"):
+            continue
+        if k not in STEP_FIELDS:
+            errs.append(f"unknown step field {k!r}")
+        elif not isinstance(v, STEP_FIELDS[k]):
+            errs.append(
+                f"step field {k!r}: expected {STEP_FIELDS[k]}, "
+                f"got {type(v).__name__}"
+            )
+    return errs
+
+
+def validate_file(path: str) -> Tuple[Dict[str, int], List[str]]:
+    """((counts by record class), errors) for a metrics JSONL file.
+    Errors carry 1-based line numbers."""
+    counts = {"step": 0, "meta": 0}
+    errs: List[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errs.append(f"line {i}: invalid JSON ({e})")
+                continue
+            line_errs = validate_record(rec)
+            errs.extend(f"line {i}: {e}" for e in line_errs)
+            if not line_errs:
+                counts["meta" if "kind" in rec else "step"] += 1
+    return counts, errs

@@ -366,6 +366,24 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
     }
 
 
+def ledger_summary(led: Dict[str, object]) -> Dict[str, object]:
+    """JSON-safe compact form of a `collective_ledger` result for the
+    telemetry run_meta record: per-op wire/payload bytes and counts plus
+    unresolved-attribution COUNTS (the full flagged lines stay with the
+    ledger; a metrics file only needs to know whether attribution was
+    complete)."""
+    return {
+        "wire_bytes": {k: float(v) for k, v in led["wire_bytes"].items()},
+        "payload_bytes": {
+            k: float(v) for k, v in led["payload_bytes"].items()
+        },
+        "count": {k: float(v) for k, v in led["count"].items()},
+        "total_wire_bytes": float(led["total_wire_bytes"]),
+        "unresolved_loops": len(led["unresolved_loops"]),
+        "unresolved_groups": len(led["unresolved_groups"]),
+    }
+
+
 def hlo_comm_report(engine, state, batch) -> Dict[str, object]:
     """Compile the engine's step for (state, batch) and return its
     collective ledger — the measured counterpart to

@@ -48,32 +48,153 @@ def device_sync(x) -> float:
     return float(np.asarray(leaf.ravel()[0:1])[0])
 
 
-class StepTimer:
-    """Rolling per-step timing: `with timer.step(): ... engine.step(...)`."""
+def _quantile(xs, q: float) -> float:
+    """Linear-interpolated quantile of a list (no numpy dependency on the
+    hot host path)."""
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    if len(ys) == 1:
+        return ys[0]
+    pos = q * (len(ys) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(ys) - 1)
+    return ys[lo] + (ys[hi] - ys[lo]) * (pos - lo)
 
-    def __init__(self, sync_every: int = 1):
+
+class StepTimer:
+    """Rolling per-step timing: `with timer.step(): ... engine.step(...)`.
+
+    Upgraded for the telemetry subsystem (tiny_deepspeed_tpu/telemetry/):
+
+      * `mark(name)` inside the step splits the wall time into named
+        segments (`data_s` loader wait, `h2d_s` host->device staging, ...);
+        the tail after the last mark — the dispatched device work plus the
+        sync — lands in `compute_s`.  Per-step dicts in `self.segments`.
+      * `watch(target)` registers a compile-count source (an engine, a
+        jitted fn, or a zero-arg int callable); each step records how many
+        NEW lowerings the watched jit cache grew by (`self.compiled_steps`),
+        so first-compile and shape-driven recompiles are attributed to the
+        step that paid for them.
+      * `p50_s` / `p95_s` percentile properties next to `mean_s`.
+      * a step whose body RAISES clears the observed output instead of
+        leaking it into the next step's sync, and records no sample.
+      * `fetch_full=True` makes the closing sync materialize the whole
+        observed leaf (<= 1024 elements) on the host in `last_host` —
+        one transfer that both closes the clock and delivers the packed
+        telemetry health vector; `last_value` is always element 0.
+    """
+
+    def __init__(self, sync_every: int = 1, fetch_full: bool = False):
         self.sync_every = sync_every
+        self.fetch_full = fetch_full
         self.times = []
+        self.segments = []       # per step: {"data_s": .., "compute_s": ..}
+        self.compiled_steps = []  # per step: lowerings paid by this step
+        self.last_value = None   # float(element 0) of the observed output
+        self.last_host = None    # host copy of the observed leaf (fetch_full)
         self._last_out = None
+        self._watched = []
+        self._segs = {}
+        self._seg_t0 = 0.0
+
+    # -- compile watching ---------------------------------------------------
+
+    def watch(self, target) -> None:
+        """Count lowerings of `target`: a ZeroEngine (tracks its `_step`
+        across retune rebuilds), a jitted function, or a callable -> int."""
+        if hasattr(target, "_cache_size"):
+            fn = target._cache_size
+        elif hasattr(target, "step"):
+            # engine-like: read its CURRENT jitted step each time, so
+            # attach-at-construction (before the first _build_step) and
+            # retune() rebuilds both stay counted
+            def fn(eng=target):
+                step = getattr(eng, "_step", None)
+                return step._cache_size() if step is not None else 0
+        elif callable(target):
+            fn = target
+        else:
+            raise TypeError(f"cannot watch {type(target).__name__}")
+        self._watched.append(fn)
+
+    def _watched_lowerings(self) -> int:
+        total = 0
+        for fn in self._watched:
+            try:
+                total += int(fn())
+            except Exception:
+                pass
+        return total
+
+    # -- the step context ---------------------------------------------------
 
     @contextlib.contextmanager
     def step(self):
         t0 = time.perf_counter()
-        yield self
-        if self._last_out is not None:
-            device_sync(self._last_out)
+        self._seg_t0 = t0
+        self._segs = {}
+        c0 = self._watched_lowerings()
+        try:
+            yield self
+        except BaseException:
+            # a failed step must not leak its stale output into the next
+            # step's sync barrier
             self._last_out = None
-        self.times.append(time.perf_counter() - t0)
+            raise
+        if self._last_out is not None:
+            leaf = jax.tree.leaves(self._last_out)[0]
+            if self.fetch_full and leaf.size <= 1024:
+                host = np.asarray(leaf).ravel()
+            else:
+                host = np.asarray(leaf.ravel()[0:1])
+            self.last_host = host
+            self.last_value = float(host[0])
+            self._last_out = None
+        now = time.perf_counter()
+        if self._segs:
+            self._segs["compute_s"] = now - self._seg_t0
+            self.segments.append(self._segs)
+        self.times.append(now - t0)
+        self.compiled_steps.append(self._watched_lowerings() - c0)
+        self._segs = {}
+
+    def mark(self, name: str) -> None:
+        """Close the current wall segment as `<name>_s`; the remainder of
+        the step (device dispatch + sync) becomes `compute_s`."""
+        now = time.perf_counter()
+        self._segs[f"{name}_s"] = now - self._seg_t0
+        self._seg_t0 = now
 
     def observe(self, out):
         """Register a step output to sync on before stopping the clock."""
         self._last_out = out
         return out
 
+    # -- summaries ----------------------------------------------------------
+
+    def _sample(self):
+        # drop the first step (compile) once there is more than one sample
+        return self.times[1:] if len(self.times) > 1 else self.times
+
     @property
     def mean_s(self) -> float:
-        xs = self.times[1:] if len(self.times) > 1 else self.times
+        xs = self._sample()
         return sum(xs) / max(1, len(xs))
+
+    @property
+    def p50_s(self) -> float:
+        return _quantile(self._sample(), 0.50)
+
+    @property
+    def p95_s(self) -> float:
+        return _quantile(self._sample(), 0.95)
+
+    @property
+    def compile_count(self) -> int:
+        """Total lowerings of the watched jits across recorded steps —
+        1 is the first compile; anything above is a recompile."""
+        return sum(self.compiled_steps)
 
 
 def _bytes(tree) -> int:
@@ -173,7 +294,14 @@ def comm_report(engine) -> Dict[str, float]:
 
 
 class MetricsLogger:
-    """Rank-0 structured metrics: JSONL file and/or stdout."""
+    """Rank-0 structured metrics: JSONL file and/or stdout.
+
+    Usable as a context manager so the file handle cannot leak when the
+    training loop raises; `close()` keeps working for manual lifetimes.
+    The record schema (step records + `kind`-tagged meta records from
+    `log_meta`) is defined in `tiny_deepspeed_tpu/telemetry/schema.py` and
+    validated by `scripts/report_run.py --check`.
+    """
 
     def __init__(self, path: Optional[str] = None, stdout: bool = True):
         self.is_rank0 = jax.process_index() == 0
@@ -182,6 +310,12 @@ class MetricsLogger:
         if path and self.is_rank0:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._fh = open(path, "a")
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     def log(self, step: int, **metrics) -> None:
         if not self.is_rank0:
@@ -196,6 +330,15 @@ class MetricsLogger:
                 for k, v in metrics.items()
             )
             print(f"step {step:5d} {shown}")
+
+    def log_meta(self, kind: str = "run_meta", **fields) -> None:
+        """One `kind`-tagged non-step record (run metadata, telemetry
+        summaries) — JSONL only, never echoed to stdout."""
+        if not self.is_rank0 or not self._fh:
+            return
+        rec = {"kind": kind, "ts": time.time(), **fields}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
 
     def close(self) -> None:
         if self._fh:
