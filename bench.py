@@ -68,6 +68,8 @@ def _config_fingerprint(env=None) -> str:
         "moe_dispatch": env.get("BENCH_MOE_DISPATCH", ""),
         "gqa": env.get("TINY_DS_GQA", ""),
         "xent": env.get("BENCH_XENT", ""),
+        "grad_comm": env.get("BENCH_GRAD_COMM", ""),
+        "grad_comm_groups": env.get("BENCH_GRAD_COMM_GROUPS", ""),
     }, sort_keys=True)
 
 
@@ -365,17 +367,14 @@ def _bench_config(model_name: str):
                      dict(batch=8, overrides={}, state_dtype=None))
 
 
-def _effective_xent_impl(cfg, n_chips: int) -> str:
+def _effective_xent_impl(cfg, n_chips: int, tokens=None) -> str:
     """The loss-head implementation a step with this config actually runs
-    (models/gpt2.py head gate): 'unfused' without fused_xent, 'pallas'
-    only on a single-device TPU-kernel target, else 'chunked'."""
-    if not cfg.fused_xent:
-        return "unfused"
-    from tiny_deepspeed_tpu.ops.dispatch import kernel_target
-    if (getattr(cfg, "fused_xent_impl", "chunked") == "pallas"
-            and kernel_target() == "tpu" and n_chips == 1):
-        return "pallas"
-    return "chunked"
+    — delegates to the ONE predicate gpt2.head itself consults
+    (models/gpt2.effective_xent_impl, mirroring moe.effective_dispatch),
+    so the A/B label can never drift from the gate."""
+    from tiny_deepspeed_tpu.models.gpt2 import effective_xent_impl
+    return effective_xent_impl(cfg, multi_device=n_chips > 1,
+                               tokens=tokens)
 
 
 def run_one(model_name: str, b=None, t=1024, iters=30):
@@ -429,6 +428,16 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         if os.environ.get("BENCH_OFFLOAD_PREFETCH"):
             # round-5 A/B knob: in-flight window of streamed moment leaves
             ek["offload_prefetch"] = int(os.environ["BENCH_OFFLOAD_PREFETCH"])
+    grad_comm = os.environ.get("BENCH_GRAD_COMM")
+    if grad_comm:
+        # round-6 A/B knob: quantized gradient collectives
+        # (parallel/comm.py) — int8/fp8 error-fed reduce-scatter.  Inert
+        # (engine warns) on a single chip, where there is no gradient
+        # collective; the record below labels what actually ran.
+        ek["grad_comm"] = grad_comm
+        if os.environ.get("BENCH_GRAD_COMM_GROUPS"):
+            # hierarchical 2-hop schedule: inner group size
+            ek["grad_comm_groups"] = int(os.environ["BENCH_GRAD_COMM_GROUPS"])
     if n_chips == 1:
         engine = SingleDevice(model, opt, mesh=mesh, **ek)
     else:
@@ -584,13 +593,17 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             # the long-context branch silently overrides (the `config` dict
             # below is the PRE-override _bench_config table)
             **({"moe_dispatch_effective": moe_eff} if moe_eff else {}),
+            **({"grad_comm": grad_comm,
+                "grad_comm_active": bool(engine._grad_comm_active)}
+               if grad_comm else {}),
             "effective": {
                 "remat": str(cfg.remat),
                 "fused_xent": str(cfg.fused_xent),
                 # the IMPL THAT RAN, mirroring gpt2.head's gate (pallas
                 # needs fused_xent + TPU kernels + a single device) — not
                 # the knob verbatim, which would mislabel fallback runs
-                "fused_xent_impl": _effective_xent_impl(cfg, n_chips),
+                "fused_xent_impl": _effective_xent_impl(
+                    cfg, n_chips, tokens=b * t // n_chips),
                 "scan_unroll": str(cfg.scan_unroll),
             },
             "config": {

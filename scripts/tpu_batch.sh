@@ -112,4 +112,20 @@ log "14. MoE sort-dispatch A/B (MoEConfig.moe_dispatch; shard-local under DP sin
 timeout 1800 env BENCH_MODEL=moe-8x124m BENCH_MOE_DISPATCH=sort python bench.py > "$OUT/bench_moe_sort.json" 2> "$OUT/bench_moe_sort.err"
 log "   rc=$? $(cat "$OUT/bench_moe_sort.json" 2>/dev/null | head -c 200)"
 
+log "15. quantized grad-collective A/B (round-6: grad_comm int8/fp8 error-fed"
+log "    reduce-scatter, parallel/comm.py — only meaningful on a multi-chip"
+log "    tunnel; on 1 chip the knob records itself inert)"
+# the fp32 baseline IS step 1's default bench — reuse it, don't re-burn
+# the tunnel window on an identical fingerprint
+cp "$OUT/bench_default.json" "$OUT/bench_gradcomm_fp32.json" 2>/dev/null \
+  && log "   fp32 baseline = step 1's bench_default.json (copied)"
+for gc in int8 fp8; do
+  timeout 2400 env BENCH_GRAD_COMM=$gc python bench.py > "$OUT/bench_gradcomm_$gc.json" 2> "$OUT/bench_gradcomm_$gc.err"
+  log "   $gc rc=$? $(cat "$OUT/bench_gradcomm_$gc.json" 2>/dev/null | head -c 160)"
+done
+log "15b. 2-hop hierarchical schedule (inner group 2 on a 2-chip-per-host topology;"
+log "     adjust BENCH_GRAD_COMM_GROUPS to the fast-link group size)"
+timeout 2400 env BENCH_GRAD_COMM=int8 BENCH_GRAD_COMM_GROUPS=2 python bench.py > "$OUT/bench_gradcomm_int8_hier.json" 2> "$OUT/bench_gradcomm_int8_hier.err"
+log "   int8 2-hop rc=$? $(cat "$OUT/bench_gradcomm_int8_hier.json" 2>/dev/null | head -c 160)"
+
 log "batch complete; results in $OUT"

@@ -1,0 +1,399 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Quantized gradient collectives (ZeroEngine grad_comm=, parallel/comm.py).
+
+Pins the contract end to end: blockwise quant/dequant round-trip bounds and
+stochastic-rounding unbiasedness, flat-vs-hierarchical schedule parity at
+the shard_map level, grad_comm="fp32" HLO byte-identity (the knob is free
+when off, same pattern as telemetry=None), int8/fp8 convergence parity
+with and without error feedback, the measured-ledger >= 3.5x gradient wire
+reduction (utils/hlo_comm.py), composition with ZeRO-2 / accumulation /
+dynamic loss scaling, the unsupported-mesh validation errors, and the
+telemetry gauges (comm bytes saved, residual norm)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tiny_deepspeed_tpu import (
+    AdamW, DDP, GPTConfig, GPT2Model, SingleDevice, Telemetry, Zero2, Zero3,
+)
+from tiny_deepspeed_tpu.parallel import comm as qcomm
+from tiny_deepspeed_tpu.parallel.mesh import make_mesh
+from tiny_deepspeed_tpu.utils.hlo_comm import collective_ledger
+
+TINY = GPTConfig(
+    block_size=32, vocab_size=128, n_layer=2, n_head=2, n_embd=32,
+    compute_dtype=jnp.float32,
+)
+
+
+def make_batch(seed=1, b=8, t=32, vocab=128, accum=None):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    shape = (accum, b, t) if accum else (b, t)
+    return (jax.random.randint(k1, shape, 0, vocab),
+            jax.random.randint(k2, shape, 0, vocab))
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPT2Model(TINY)
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+class TestQuantPrimitives:
+    def test_padded_size(self):
+        assert qcomm.padded_size(1, 8, 256) == 8 * 256
+        assert qcomm.padded_size(8 * 256, 8, 256) == 8 * 256
+        assert qcomm.padded_size(8 * 256 + 1, 8, 256) == 2 * 8 * 256
+
+    @pytest.mark.parametrize("mode,tol", [("int8", 0.01), ("fp8", 0.05)])
+    def test_round_trip(self, mode, tol):
+        # blocks at wildly different magnitudes: the per-block scale is
+        # what keeps the error relative to the BLOCK, not the tensor
+        x = jax.random.normal(jax.random.PRNGKey(0), (16 * 256,))
+        mags = jnp.repeat(10.0 ** jnp.arange(-8.0, 8.0), 256)
+        x = x * mags
+        q, s = qcomm.quantize_blockwise(x, mode, block=256)
+        assert s.shape == (16, 1)
+        deq = qcomm.dequantize_blockwise(q, s)
+        err = float(jnp.linalg.norm(deq - x) / jnp.linalg.norm(x))
+        assert err < tol, f"{mode} round-trip rel error {err}"
+
+    def test_int8_stochastic_rounding_unbiased(self):
+        # a fixed block whose values sit strictly BETWEEN int8 grid points:
+        # nearest-rounding is maximally biased, stochastic must average out
+        x = jnp.full((256,), 0.3) * jnp.linspace(0.1, 1.0, 256)
+        x = x.at[0].set(1.0)  # pins the absmax -> fixed scale across draws
+        scale = 1.0 / 127.0
+        acc = np.zeros((256,), np.float64)
+        draws = 500
+        for i in range(draws):
+            q, s = qcomm.quantize_blockwise(
+                x, "int8", block=256, rng=jax.random.PRNGKey(i)
+            )
+            acc += np.asarray(qcomm.dequantize_blockwise(q, s), np.float64)
+        mean = acc / draws
+        # std of the mean ~ scale / sqrt(12 * draws) ~ 1e-4; 0.002 ~ 20 sigma
+        assert float(np.max(np.abs(mean - np.asarray(x)))) < 0.002 * 127 * scale
+        # and nearest rounding (rng=None) is deterministically different
+        q0, s0 = qcomm.quantize_blockwise(x, "int8", block=256)
+        q1, s1 = qcomm.quantize_blockwise(x, "int8", block=256)
+        assert np.array_equal(np.asarray(q0), np.asarray(q1))
+
+    def test_piece_owner_is_permutation(self):
+        for n, inner in ((8, None), (8, 2), (8, 4), (16, 4)):
+            owner = qcomm.piece_owner(n, inner)
+            assert sorted(owner.tolist()) == list(range(n))
+        assert qcomm.piece_owner(8, None).tolist() == list(range(8))
+
+    def test_bad_mode_raises(self):
+        with pytest.raises(ValueError):
+            qcomm.quantize_blockwise(jnp.zeros((256,)), "fp4")
+
+    @pytest.mark.parametrize("mode,stochastic", [
+        ("int8", False), ("int8", True), ("fp8", False),
+    ])
+    def test_pallas_quantizer_matches_xla(self, mode, stochastic):
+        """The fused Pallas quantizer (ops/quant_pallas.py, interpret
+        mode) is the same function as the XLA path — both consume one
+        dither draw, so codes and scales agree exactly."""
+        from tiny_deepspeed_tpu.ops import quant_pallas
+        from tiny_deepspeed_tpu.ops.dispatch import kernel_target_forced
+        x = jax.random.normal(jax.random.PRNGKey(2), (16 * 256,))
+        x = x * jnp.repeat(10.0 ** jnp.arange(-8.0, 8.0), 256)
+        rng = jax.random.PRNGKey(9) if stochastic else None
+        qx, sx = qcomm.quantize_blockwise(x, mode, 256, rng)
+        old = quant_pallas._INTERPRET
+        quant_pallas._INTERPRET = True
+        try:
+            with kernel_target_forced("tpu"):
+                qp, sp = qcomm.quantize_blockwise(x, mode, 256, rng)
+        finally:
+            quant_pallas._INTERPRET = old
+        assert qp.dtype == qx.dtype and qp.shape == qx.shape
+        np.testing.assert_allclose(np.asarray(sp), np.asarray(sx),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(qp, np.float32), np.asarray(qx, np.float32)
+        )
+
+
+# ---------------------------------------------------------------------------
+# the schedule, straight at the shard_map level
+# ---------------------------------------------------------------------------
+
+class TestSchedule:
+    @pytest.mark.parametrize("inner", [None, 2, 4])
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_sync_matches_exact_mean(self, mode, inner):
+        mesh = make_mesh()
+        n = mesh.shape["data"]
+        e = n * 256 * 2
+        xg = jax.random.normal(jax.random.PRNGKey(3), (n, e)) * 0.1
+        xg = jax.device_put(xg, NamedSharding(mesh, P("data")))
+        exact = np.asarray(jnp.mean(xg, axis=0))
+
+        def local(x):
+            tree = {"g": x[0]}
+            red, res = qcomm.quantized_grad_sync(
+                tree, None, "data", n, mode, block=256, inner=inner,
+                rng=jax.random.PRNGKey(7) if mode == "int8" else None,
+            )
+            assert res is None
+            return red["g"][None]
+
+        out = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=(P("data"),),
+            out_specs=P("data"), check_vma=False,
+        ))(xg)
+        got = np.asarray(out[0])
+        rel = float(np.linalg.norm(got - exact) / np.linalg.norm(exact))
+        # two quantization hops (RS + AG); int8 blockwise keeps ~1e-2,
+        # e4m3's 3 mantissa bits land around 4-6% per hop
+        tol = 0.03 if mode == "int8" else 0.12
+        assert rel < tol, f"{mode} inner={inner}: rel {rel}"
+
+    def test_error_feedback_residual_is_what_was_dropped(self):
+        mesh = make_mesh()
+        n = mesh.shape["data"]
+        e = n * 256
+        xg = jax.random.normal(jax.random.PRNGKey(5), (n, e))
+        xg = jax.device_put(xg, NamedSharding(mesh, P("data")))
+        res0 = jax.device_put(
+            jnp.zeros((n, e)), NamedSharding(mesh, P("data"))
+        )
+
+        def local(x, r):
+            tree = {"g": x[0]}
+            red, res = qcomm.quantized_grad_sync(
+                tree, r[0], "data", n, "int8", block=256,
+            )
+            return red["g"][None], res[None]
+
+        _, res = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=(P("data"), P("data")), check_vma=False,
+        ))(xg, res0)
+        res = np.asarray(res)
+        x = np.asarray(xg)
+        # residual == local grad minus its own dequantized transmission:
+        # recompute per-row and compare.  Exact equality up to rounding
+        # TIES — jit fusion may reassociate the absmax and break a .5 tie
+        # the other way, moving single elements by one full quant step
+        blocks = np.abs(x).reshape(n, -1, 256).max(axis=2)
+        step = blocks / 127.0  # int8 step per block
+        for i in range(n):
+            q, s = qcomm.quantize_blockwise(jnp.asarray(x[i]), "int8", 256)
+            expect = x[i] - np.asarray(qcomm.dequantize_blockwise(q, s))
+            diff = np.abs(res[i] - expect).reshape(-1, 256)
+            assert (diff <= step[i][:, None] * 1.01 + 1e-6).all()
+            assert (diff > 1e-6).mean() < 0.005  # ties are rare
+        # bounded by half an int8 step of the block absmax (+ tie slack)
+        bound = (step * 0.51 + 1e-6)[:, :, None]
+        assert (np.abs(res.reshape(n, -1, 256)) <= bound).all()
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+def run_curve(model, eng_cls=DDP, steps=20, seed=1, **kw):
+    eng = eng_cls(model, AdamW(lr=1e-3), **kw)
+    state = eng.init(jax.random.PRNGKey(0))
+    batch = make_batch(seed, accum=kw.get("accum_steps"))
+    losses = []
+    for _ in range(steps):
+        state, loss = eng.step(state, batch)
+        losses.append(float(loss))
+    return losses, state, eng
+
+
+class TestEngineGradComm:
+    def test_fp32_hlo_byte_identical(self, model):
+        """grad_comm="fp32" is FREE: the compiled step program is the same
+        bytes as an un-knobbed engine (the telemetry=None pattern)."""
+        e0 = DDP(model, AdamW(lr=1e-3))
+        e1 = DDP(model, AdamW(lr=1e-3), grad_comm="fp32")
+        s0 = e0.init(jax.random.PRNGKey(0))
+        s1 = e1.init(jax.random.PRNGKey(0))
+        batch = make_batch()
+        assert s1.grad_residual is None
+        assert e0._step.lower(s0, batch).as_text() \
+            == e1._step.lower(s1, batch).as_text()
+
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_convergence_parity_with_error_feedback(self, model, mode):
+        base, _, _ = run_curve(model, steps=20)
+        quant, state, _ = run_curve(model, steps=20, grad_comm=mode)
+        rel = [abs(a - b) / a for a, b in zip(base, quant)]
+        assert max(rel) < 0.05, f"max divergence {max(rel):.4f}"
+        assert quant[-1] < quant[0] - 0.1  # and it actually trains
+        # the residual is alive: nonzero, finite, bounded
+        res = np.asarray(state.grad_residual)
+        assert res.shape[0] == 8 and np.isfinite(res).all()
+        assert 0 < float(np.abs(res).max())
+
+    def test_convergence_without_error_feedback(self, model):
+        base, _, _ = run_curve(model, steps=20)
+        quant, state, _ = run_curve(
+            model, steps=20, grad_comm="int8",
+            grad_comm_error_feedback=False,
+        )
+        assert state.grad_residual is None
+        rel = [abs(a - b) / a for a, b in zip(base, quant)]
+        assert max(rel) < 0.10
+        assert quant[-1] < quant[0] - 0.1
+
+    def test_hierarchical_2hop_tracks_flat(self, model):
+        flat, _, _ = run_curve(model, steps=10, grad_comm="int8")
+        hier, _, eng = run_curve(model, steps=10, grad_comm="int8",
+                                 grad_comm_groups=4)
+        rel = [abs(a - b) / a for a, b in zip(flat, hier)]
+        assert max(rel) < 0.02
+        assert "2-hop inner=4" in eng.describe()
+
+    def test_ledger_gradient_wire_drops_4x(self, model):
+        """The acceptance number: int8 grad_comm cuts the measured
+        (post-SPMD HLO ledger) collective wire >= 3.5x vs fp32 — under
+        DDP the gradient all-reduce IS essentially all the wire."""
+        batch = make_batch()
+        e0 = DDP(model, AdamW(lr=1e-3))
+        s0 = e0.init(jax.random.PRNGKey(0))
+        led_f = collective_ledger(
+            e0._step.lower(s0, batch).compile().as_text()
+        )
+        eq = DDP(model, AdamW(lr=1e-3), grad_comm="int8")
+        sq = eq.init(jax.random.PRNGKey(0))
+        led_q = collective_ledger(
+            eq._step.lower(sq, batch).compile().as_text()
+        )
+        assert not led_f["unresolved_groups"]
+        assert not led_q["unresolved_groups"]
+        ratio = led_f["total_wire_bytes"] / led_q["total_wire_bytes"]
+        assert ratio >= 3.5, (
+            f"wire only dropped {ratio:.2f}x: "
+            f"{led_f['wire_bytes']} -> {led_q['wire_bytes']}"
+        )
+        # the honest per-dtype view: the quantized step's wire is
+        # dominated by 1-byte values, not the f32 scales riding along
+        by_dt = led_q["wire_bytes_by_dtype"]
+        assert by_dt.get("s8", 0) > 0.6 * sum(by_dt.values())
+
+    def test_zero2_composes_and_trains(self, model):
+        losses, state, eng = run_curve(model, eng_cls=Zero2, steps=8,
+                                       grad_comm="int8")
+        assert losses[-1] < losses[0]
+        batch = make_batch()
+        txt = eng._step.lower(state, batch).compile().as_text()
+        assert "all-to-all" in txt  # the explicit quantized schedule ran
+
+    def test_accum_composes(self, model):
+        base, _, _ = run_curve(model, steps=8, accum_steps=2)
+        quant, _, _ = run_curve(model, steps=8, accum_steps=2,
+                                grad_comm="int8")
+        rel = [abs(a - b) / a for a, b in zip(base, quant)]
+        assert max(rel) < 0.05
+
+    def test_dynamic_loss_scale_composes(self, model):
+        losses, state, _ = run_curve(model, steps=8, grad_comm="int8",
+                                     loss_scale="dynamic")
+        assert losses[-1] < losses[0]
+        assert np.isfinite(np.asarray(state.grad_residual)).all()
+
+    def test_overflow_step_rolls_back_residual(self, model):
+        """A dynamic-scaling overflow step discards the whole update — the
+        consumed error-feedback residual must roll back with it, or the
+        deferred gradient signal is lost on every scale-halving step."""
+        eng = DDP(model, AdamW(lr=1e-3), grad_comm="int8",
+                  loss_scale="dynamic")
+        state = eng.init(jax.random.PRNGKey(0))
+        state, _ = eng.step(state, make_batch())  # residual now nonzero
+        res_before = np.asarray(state.grad_residual).copy()
+        assert float(np.abs(res_before).max()) > 0
+        params = dict(state.params)
+        name = next(iter(params))
+        params[name] = jnp.full_like(params[name], jnp.nan)
+        poisoned = state.replace(params=params)
+        new, _ = eng.step(poisoned, make_batch())
+        assert float(new.scaler["scale"]) == 2.0 ** 14  # overflow detected
+        np.testing.assert_array_equal(
+            np.asarray(new.grad_residual), res_before
+        )
+
+    def test_single_device_inert_with_warning(self, model):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            eng = SingleDevice(model, AdamW(lr=1e-3), grad_comm="int8")
+        assert any("inert" in str(x.message) for x in w)
+        assert not eng._grad_comm_active
+        state = eng.init(jax.random.PRNGKey(0))
+        assert state.grad_residual is None
+        state, loss = eng.step(state, make_batch())
+        assert np.isfinite(float(loss))
+
+    def test_unsupported_configs_raise(self, model):
+        with pytest.raises(ValueError, match="grad_comm must be"):
+            DDP(model, AdamW(lr=1e-3), grad_comm="int4")
+        with pytest.raises(ValueError, match="stages 0-2"):
+            Zero3(model, AdamW(lr=1e-3), grad_comm="int8")
+        with pytest.raises(ValueError, match="pure data-parallel"):
+            DDP(model, AdamW(lr=1e-3), grad_comm="int8",
+                tensor_parallel=2)
+        with pytest.raises(ValueError, match="proper divisor"):
+            DDP(model, AdamW(lr=1e-3), grad_comm="int8",
+                grad_comm_groups=3)
+        with pytest.raises(ValueError, match="requires grad_comm"):
+            DDP(model, AdamW(lr=1e-3), grad_comm_groups=4)
+
+    def test_checkpoint_roundtrip_carries_residual(self, model, tmp_path):
+        from tiny_deepspeed_tpu.utils.checkpoint import (
+            load_checkpoint, save_checkpoint,
+        )
+        _, state, eng = run_curve(model, steps=3, grad_comm="int8")
+        save_checkpoint(str(tmp_path), state, step=3)
+        restored = load_checkpoint(str(tmp_path), eng)
+        np.testing.assert_array_equal(
+            np.asarray(state.grad_residual), np.asarray(restored.grad_residual)
+        )
+        # and a legacy (residual-free) checkpoint resumes with zeros
+        fp32_eng = DDP(model, AdamW(lr=1e-3))
+        fp32_state = fp32_eng.init(jax.random.PRNGKey(0))
+        save_checkpoint(str(tmp_path), fp32_state, step=10)
+        resumed = load_checkpoint(str(tmp_path), eng, step=10)
+        assert resumed.grad_residual is not None
+        assert float(np.abs(np.asarray(resumed.grad_residual)).max()) == 0.0
+        state2, loss = eng.step(resumed, make_batch())
+        assert np.isfinite(float(loss))
+
+    def test_telemetry_gauges(self, model):
+        telem = Telemetry()
+        eng = DDP(model, AdamW(lr=1e-3), grad_comm="int8",
+                  telemetry=telem)
+        state = eng.init(jax.random.PRNGKey(0))
+        batch = make_batch()
+        state, _ = eng.step(state, batch)
+        out = eng.telemetry.capture_compiled(state, batch)
+        assert out["grad_comm"]["mode"] == "int8"
+        saved = telem.gauge("grad_comm_wire_saved_bytes")
+        assert saved is not None and saved > 0
+        norm = telem.sample_grad_residual(state)
+        assert norm is not None and norm > 0
+        assert telem.gauge("grad_residual_norm") == norm
+        # model report knows the schedule replaced the fp32 collective
+        rep = out["comm_model"]
+        assert rep["grad_comm"] == "int8"
+        assert rep["grad_quant_sync_bytes"] > 0
+        assert rep["grad_allreduce_bytes"] == 0.0
+        # fp32 state has no residual to sample
+        assert Telemetry().sample_grad_residual(
+            DDP(model, AdamW(lr=1e-3)).init(jax.random.PRNGKey(0))
+        ) is None

@@ -164,3 +164,41 @@ class TestModelIntegration:
                 losses.append(float(loss))
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
+
+
+class TestViableBlockGuard:
+    """Round-5 ADVICE: an S with no sane 8-aligned block <= 256 must fall
+    back to the chunked XLA path, not run one (S, d) VMEM-resident
+    block."""
+
+    def test_viable_token_block(self):
+        from tiny_deepspeed_tpu.ops.xent_pallas import viable_token_block
+        assert viable_token_block(2048)      # 256 divides
+        assert viable_token_block(64)        # small single block is fine
+        assert viable_token_block(250)       # <= 256, one block
+        assert not viable_token_block(1033)  # prime > 256: nothing fits
+        assert not viable_token_block(4098)  # 2*3*683: no 8-aligned divisor
+
+    def test_awkward_s_falls_back_and_matches(self):
+        # prime token count > 256: the guard must route to the chunked
+        # XLA path (value+grads still exact vs materialized logits)
+        x, w, tg = _data(b=1, t=263)
+        np.testing.assert_allclose(
+            float(pallas_fused_xent(x, w, tg)), float(_ref(x, w, tg)),
+            rtol=1e-5, atol=1e-6)
+        gx = jax.grad(lambda x_: pallas_fused_xent(x_, w, tg))(x)
+        gr = jax.grad(lambda x_: _ref(x_, w, tg))(x)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_shared_predicate_consults_guard(self):
+        from tiny_deepspeed_tpu.models.gpt2 import effective_xent_impl
+        from tiny_deepspeed_tpu.ops.dispatch import kernel_target_forced
+        cfg = type("C", (), {"fused_xent": True,
+                             "fused_xent_impl": "pallas"})()
+        with kernel_target_forced("tpu"):
+            assert effective_xent_impl(cfg, tokens=2048) == "pallas"
+            assert effective_xent_impl(cfg, tokens=1033) == "chunked"
+            assert effective_xent_impl(cfg, multi_device=True) == "chunked"
+            assert effective_xent_impl(cfg, seq_sharded=True) == "unfused"
+        assert effective_xent_impl(cfg) == "chunked"  # cpu kernel target

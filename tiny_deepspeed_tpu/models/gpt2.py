@@ -164,6 +164,29 @@ GPT2_PRESETS: Dict[str, GPTConfig] = {
 }
 
 
+def effective_xent_impl(cfg, multi_device: bool = False,
+                        seq_sharded: bool = False,
+                        tokens: Optional[int] = None) -> str:
+    """The loss-head implementation a step with this config/mesh actually
+    runs — ONE predicate shared by `GPT2Model.head` and bench.py's A/B
+    record (mirroring moe.effective_dispatch), so a measurement can never
+    be labeled with a knob value that fell back.
+
+    Returns "unfused" (materialized logits), "chunked" (XLA
+    fused_linear_xent ladder), or "pallas" (ops/xent_pallas.py — only on
+    a single-device TPU kernel target, and only when `tokens` (= B*T, if
+    known) admits a viable VMEM token-block)."""
+    if not getattr(cfg, "fused_xent", False) or seq_sharded:
+        return "unfused"
+    if getattr(cfg, "fused_xent_impl", "chunked") == "pallas":
+        from ..ops.dispatch import kernel_target
+        from ..ops.xent_pallas import viable_token_block
+        if (kernel_target() == "tpu" and not multi_device
+                and (tokens is None or viable_token_block(tokens))):
+            return "pallas"
+    return "chunked"
+
+
 def _dropout(x, key, rate: float):
     """Inverted dropout: zero with prob `rate`, survivors scaled 1/(1-rate)
     so eval needs no rescaling.  `key` may be a raw (2,) uint32 key row
@@ -632,17 +655,20 @@ class GPT2Model:
         w = self._lm_head_w(params)
 
         if targets is not None:
-            seq_sharded = pctx is not None and pctx.seq_parallel
-            if c.fused_xent and not seq_sharded:
-                from ..ops.dispatch import kernel_target
-                if (c.fused_xent_impl == "pallas"
-                        and kernel_target() == "tpu"
-                        and not (pctx is not None
-                                 and pctx.is_multi_device)):
-                    # single-device only for now: the custom call would
-                    # force GSPMD to gather the vocab-sharded w under tp
-                    from ..ops.xent_pallas import pallas_fused_xent
-                    return pallas_fused_xent(x, w, targets)
+            # ONE shared predicate (effective_xent_impl) decides the head
+            # implementation for both this gate and bench.py's A/B record
+            impl = effective_xent_impl(
+                c,
+                multi_device=pctx is not None and pctx.is_multi_device,
+                seq_sharded=pctx is not None and pctx.seq_parallel,
+                tokens=x.shape[0] * x.shape[1],
+            )
+            if impl == "pallas":
+                # single-device only for now: the custom call would
+                # force GSPMD to gather the vocab-sharded w under tp
+                from ..ops.xent_pallas import pallas_fused_xent
+                return pallas_fused_xent(x, w, targets)
+            if impl == "chunked":
                 from ..ops.softmax_xent import fused_linear_xent
                 return fused_linear_xent(x, w, targets)
             logits = linear(x, w, None)

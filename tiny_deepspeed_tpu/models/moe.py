@@ -315,6 +315,15 @@ class MoEGPT(GPT2Model):
                                     "moe.proj.b")
                      for n in (base, base + "#scale") if n in bp]
             dax = pctx.data_axis
+            if capacity is not None:
+                # an explicit capacity names a GLOBAL slot budget; applied
+                # as-is inside the shard-local sort it would multiply
+                # n_shard-fold on a multi-device mesh.  Prorate by the
+                # token-shard count (ceil, so tiny decode budgets never
+                # hit zero) — same proration the formula-driven default
+                # gets for free from the local S
+                n_sh = int(pctx.mesh.shape[dax])
+                capacity = -(-int(capacity) // n_sh)
 
             def local(xs_l, *ws):
                 y_l, aux_l = self._moe_mlp_sort(

@@ -62,6 +62,17 @@ def _pick_bs(s: int, want: int = 256) -> int:
     return b if b >= 8 and s % b == 0 else s
 
 
+def viable_token_block(s: int, want: int = 256) -> bool:
+    """Whether the kernel has a sane token-block for S tokens: an
+    8-aligned divisor <= want, or S small enough that one (S, d) block is
+    itself VMEM-resident.  When this is False (e.g. a prime S > 256),
+    `pallas_fused_xent` falls back to the chunked XLA path instead of
+    attempting a single full-size VMEM block — also consulted by the
+    shared head-impl predicate (models/gpt2.effective_xent_impl) so
+    bench A/B labels can't drift from what actually ran."""
+    return _pick_bs(s, want) != s or s <= want
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -253,18 +264,30 @@ _BV_DX = 512
 _BV_DW = 512
 
 
-@jax.custom_vjp
 def pallas_fused_xent(x, w, targets):
     """Mean NLL of logits = x @ w, logits never materialized.
 
     x (B, T, D) or (S, D); w (D, V); targets matching x's leading dims.
-    """
+    Falls back to the chunked XLA `fused_linear_xent` when no viable
+    token-block exists for this S (`viable_token_block`): without the
+    guard an awkward S would run as a single (S, d) VMEM-resident block
+    and blow the scoped-vmem limit at real sizes."""
+    s = 1
+    for dim in x.shape[:-1]:
+        s *= dim
+    if not viable_token_block(s):
+        from .softmax_xent import fused_linear_xent
+        return fused_linear_xent(x, w, targets)
+    return _pallas_fused_xent(x, w, targets)
+
+
+@jax.custom_vjp
+def _pallas_fused_xent(x, w, targets):
     loss, _ = _pfx_fwd(x, w, targets)
     return loss
 
 
 def _pfx_fwd(x, w, targets):
-    lead = x.shape[:-1]
     d = x.shape[-1]
     xf = x.reshape(-1, d)
     tf = targets.reshape(-1)
@@ -289,4 +312,4 @@ def _pfx_bwd(res, g):
     return dx.reshape(*lead, d), dw.astype(w.dtype), zero
 
 
-pallas_fused_xent.defvjp(_pfx_fwd, _pfx_bwd)
+_pallas_fused_xent.defvjp(_pfx_fwd, _pfx_bwd)

@@ -267,6 +267,24 @@ class Telemetry:
         )
         return out
 
+    def sample_grad_residual(self, state) -> Optional[float]:
+        """Error-feedback residual norm gauge (grad_comm int8/fp8,
+        parallel/comm.py): the global L2 norm of
+        TrainState.grad_residual — how much gradient signal is currently
+        deferred to next step.  A healthy run keeps it bounded (the
+        feedback loop re-injects it); monotone growth means quantization
+        error is outrunning the gradient signal.  One host transfer —
+        call at telemetry cadence, not every step.  Returns None when the
+        state carries no residual."""
+        res = getattr(state, "grad_residual", None)
+        if res is None:
+            return None
+        norm = float(np.sqrt(np.sum(
+            np.square(np.asarray(res, dtype=np.float64))
+        )))
+        self.gauge("grad_residual_norm", norm)
+        return norm
+
     def capture_compiled(self, state, batch, engine=None):
         """Measured collective gauges: compile the engine's step for
         (state, batch) and read the REAL collective ledger off the post-
@@ -292,6 +310,17 @@ class Telemetry:
             )
         self.gauge("measured_wire_bytes", measured["total_wire_bytes"])
         self.gauge("modeled_wire_bytes", modeled)
+        mw = model_rep.get("grad_comm_model")
+        if mw:
+            # quantized gradient collectives (parallel/comm.py): modeled
+            # wire saved vs the fp32 all-reduce this schedule replaces —
+            # read off comm_report's model so there is ONE accounting site
+            out["grad_comm"] = mw
+            self.gauge("grad_comm_wire_bytes", mw["quant_wire_bytes"])
+            self.gauge(
+                "grad_comm_wire_saved_bytes",
+                mw["fp32_allreduce_wire_bytes"] - mw["quant_wire_bytes"],
+            )
         try:
             mem = compiled.memory_analysis()
             out["aot"] = {

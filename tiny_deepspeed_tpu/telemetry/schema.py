@@ -66,6 +66,9 @@ META_FIELDS: Dict[str, tuple] = {
     "comm_model": dict,
     "comm_measured": dict,
     "comm_delta": _NUM,
+    # quantized grad-collective model (parallel/comm.modeled_wire_bytes):
+    # mode, elems_padded, quant vs fp32-all-reduce wire bytes
+    "grad_comm": dict,
     "comm_error": str,
     "aot": dict,
     # registry snapshot (Telemetry.flush)

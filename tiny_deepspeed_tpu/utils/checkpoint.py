@@ -16,10 +16,12 @@ materialization on any single host).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 
 def _checkpointer():
@@ -80,6 +82,7 @@ def load_checkpoint(directory: str, engine=None, step: Optional[int] = None,
             opt_state=engine._opt_shardings,
             scaler=engine._scaler_shardings,
             dropout_base=engine._dropout_shardings,
+            grad_residual=getattr(engine, "_residual_shardings", None),
         )
         target = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
@@ -105,5 +108,20 @@ def load_checkpoint(directory: str, engine=None, step: Optional[int] = None,
                 jax.random.PRNGKey(0xD0), engine._dropout_shardings
             )
             state = dataclasses.replace(state, dropout_base=base)
+        if getattr(engine, "_residual_shardings", None) is not None \
+                and state.grad_residual is None:
+            # checkpoint saved without grad_comm error feedback (or
+            # pre-round-6): resume with a zero residual — the feedback
+            # loop re-fills it within a step; only the one step's
+            # quantization error goes uncompensated
+            state = dataclasses.replace(
+                state,
+                grad_residual=jax.jit(
+                    functools.partial(
+                        jnp.zeros, engine._residual_shape, jnp.float32
+                    ),
+                    out_shardings=engine._residual_shardings,
+                )(),
+            )
         return state
     return _checkpointer().restore(path, target)

@@ -84,8 +84,12 @@ _TRUE_FALSE_RE = re.compile(
 )
 
 
-def _shape_bytes(segment: str) -> int:
-    total = 0
+def _shape_bytes_by_dtype(segment: str) -> Dict[str, int]:
+    """Payload bytes of every typed shape in an HLO segment, keyed by
+    dtype — the per-dtype split is what lets the ledger show a quantized
+    collective's s8/f8 bytes next to its f32 scales (grad_comm,
+    parallel/comm.py)."""
+    out: Dict[str, int] = {}
     for dt, dims in _SHAPE_RE.findall(segment):
         if dt not in _DTYPE_BYTES:
             continue
@@ -93,8 +97,12 @@ def _shape_bytes(segment: str) -> int:
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
-    return total
+        out[dt] = out.get(dt, 0) + n * _DTYPE_BYTES[dt]
+    return out
+
+
+def _shape_bytes(segment: str) -> int:
+    return sum(_shape_bytes_by_dtype(segment).values())
 
 
 def _split_computations(text: str) -> Dict[str, List[str]]:
@@ -214,6 +222,10 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
     Returns {
       "payload_bytes": {op: logical result bytes, loop-multiplied},
       "wire_bytes":    {op: ring-model wire bytes},
+      "wire_bytes_by_dtype": {dtype: ring-model wire bytes — how much of
+                            the wire moves at which precision; the honest
+                            view of quantized collectives (grad_comm s8/f8
+                            values vs their f32 scales)},
       "count":         {op: op executions},
       "total_wire_bytes": float,
       "unresolved_loops": [loop bodies whose trip count defaulted to 1],
@@ -241,7 +253,7 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                 fusion_caller.setdefault(m.group(1), caller)
 
     # per-computation: local collectives and calls to other computations
-    local: Dict[str, List[Tuple[str, int, int]]] = {}
+    local: Dict[str, List[Tuple[str, int, int, Dict[str, int]]]] = {}
     edges: Dict[str, List[Tuple[str, int, str]]] = {}
     unresolved: List[str] = []
     unresolved_groups: List[str] = []
@@ -265,8 +277,9 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                 if n is None:
                     unresolved_groups.append(ln.strip()[:160])
                     n = 1
+                by_dt = _shape_bytes_by_dtype(seg)
                 local[name].append(
-                    ("reduce-scatter", _shape_bytes(seg), n)
+                    ("reduce-scatter", sum(by_dt.values()), n, by_dt)
                 )
                 continue  # deliberately NOT walked into (see _FUSION_CALL_RE)
             for op in _COLLECTIVES:
@@ -298,7 +311,8 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                 if n is None:
                     unresolved_groups.append(ln.strip()[:160])
                     n = 1
-                local[name].append((op, _shape_bytes(seg), n))
+                by_dt = _shape_bytes_by_dtype(seg)
+                local[name].append((op, sum(by_dt.values()), n, by_dt))
                 break
             wm = _WHILE_RE.search(ln)
             if wm:
@@ -331,12 +345,13 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
 
     payload: Dict[str, float] = {}
     wire: Dict[str, float] = {}
+    wire_by_dtype: Dict[str, float] = {}
     count: Dict[str, float] = {}
 
     def walk(comp: str, mult: float, seen: tuple) -> None:
         if comp in seen:  # cycles don't exist in HLO; belt and braces
             return
-        for op, b, n in local.get(comp, []):
+        for op, b, n, by_dt in local.get(comp, []):
             payload[op] = payload.get(op, 0.0) + mult * b
             count[op] = count.get(op, 0.0) + mult
             if op == "all-reduce":
@@ -350,6 +365,13 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
             else:  # all-to-all
                 w = b * (n - 1) / n if n > 1 else 0.0
             wire[op] = wire.get(op, 0.0) + mult * w
+            if b:
+                # the ring formulas above are linear in the payload, so
+                # the per-dtype wire split is just proportional
+                for dt, db in by_dt.items():
+                    wire_by_dtype[dt] = (
+                        wire_by_dtype.get(dt, 0.0) + mult * w * db / b
+                    )
         for child, trips, _kind in edges.get(comp, []):
             walk(child, mult * trips, seen + (comp,))
 
@@ -359,6 +381,7 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
     return {
         "payload_bytes": payload,
         "wire_bytes": wire,
+        "wire_bytes_by_dtype": wire_by_dtype,
         "count": count,
         "total_wire_bytes": sum(wire.values()),
         "unresolved_loops": unresolved,
@@ -376,6 +399,10 @@ def ledger_summary(led: Dict[str, object]) -> Dict[str, object]:
         "wire_bytes": {k: float(v) for k, v in led["wire_bytes"].items()},
         "payload_bytes": {
             k: float(v) for k, v in led["payload_bytes"].items()
+        },
+        "wire_bytes_by_dtype": {
+            k: float(v)
+            for k, v in led.get("wire_bytes_by_dtype", {}).items()
         },
         "count": {k: float(v) for k, v in led["count"].items()},
         "total_wire_bytes": float(led["total_wire_bytes"]),

@@ -271,14 +271,36 @@ def comm_report(engine) -> Dict[str, float]:
     # the shard — accum_steps x the wire bytes (TPU topology measurement,
     # PROFILE.md zero2-accum4 row: 4x the single-step reduce-scatter).
     n_sync = int(getattr(engine, "accum_steps", 1)) if stage >= 2 else 1
+    # grad_comm != fp32 (parallel/comm.py): the explicit quantized
+    # schedule REPLACES the partitioner's gradient collective — one
+    # error-fed int8/fp8 all-to-all reduce-scatter + quantized all-gather
+    # per step (accumulation syncs once, so no n_sync multiplier), priced
+    # by the same ring conventions via comm.modeled_wire_bytes
+    quant = bool(getattr(engine, "_grad_comm_active", False))
+    quant_model = None
+    if quant:
+        from ..parallel.comm import modeled_wire_bytes
+        n_elems = sum(int(np.prod(s.shape)) for s in shapes.values())
+        quant_model = modeled_wire_bytes(
+            n_elems, n, engine.grad_comm,
+            block=engine.grad_comm_block, inner=engine.grad_comm_groups,
+        )
     report = {
         "devices": n,
         "param_bytes": g,
-        "grad_allreduce_bytes": 2 * g_cd * ring if stage <= 1 and n > 1
-        else 0.0,
-        "grad_reduce_scatter_bytes": n_sync * g * ring if stage >= 2
-        else 0.0,
-        "grad_reduce_scatter_is_upper_bounded_by_allreduce": stage >= 2,
+        "grad_comm": getattr(engine, "grad_comm", "fp32"),
+        # full schedule model kept alongside the headline number so
+        # downstream gauges (telemetry capture_compiled) read ONE
+        # accounting site instead of re-deriving it
+        "grad_comm_model": quant_model,
+        "grad_quant_sync_bytes":
+        quant_model["quant_wire_bytes"] if quant_model else 0.0,
+        "grad_allreduce_bytes": 2 * g_cd * ring
+        if stage <= 1 and n > 1 and not quant else 0.0,
+        "grad_reduce_scatter_bytes": n_sync * g * ring
+        if stage >= 2 and not quant else 0.0,
+        "grad_reduce_scatter_is_upper_bounded_by_allreduce":
+        stage >= 2 and not quant,
         "param_all_gather_bytes": g * ring if stage in (1, 2) else 0.0,
         # ZeRO-3: block params gathered per layer in fwd AND in the remat
         # bwd; non-block params once — all at compute precision
