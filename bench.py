@@ -70,6 +70,7 @@ def _config_fingerprint(env=None) -> str:
         "xent": env.get("BENCH_XENT", ""),
         "grad_comm": env.get("BENCH_GRAD_COMM", ""),
         "grad_comm_groups": env.get("BENCH_GRAD_COMM_GROUPS", ""),
+        "grad_buckets": env.get("BENCH_GRAD_BUCKETS", ""),
     }, sort_keys=True)
 
 
@@ -248,6 +249,10 @@ def _retry_or_diagnose(exc: BaseException) -> None:
         cached.setdefault("extra", {}).update(extra)
         cached.pop("measured_at_epoch", None)
         cached.pop("config_fingerprint", None)
+        # TOP-LEVEL staleness flag: any cached substitution is not a live
+        # measurement of THIS invocation — buried in extra, trajectory
+        # tooling treated the number as fresh
+        cached["stale"] = True
         print(json.dumps(cached))
         sys.exit(0)
     print(json.dumps({
@@ -438,6 +443,13 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         if os.environ.get("BENCH_GRAD_COMM_GROUPS"):
             # hierarchical 2-hop schedule: inner group size
             ek["grad_comm_groups"] = int(os.environ["BENCH_GRAD_COMM_GROUPS"])
+    grad_buckets = os.environ.get("BENCH_GRAD_BUCKETS")
+    if grad_buckets:
+        # round-7 A/B knob: bucketed backward-overlapped gradient release
+        # (engine grad_buckets=) — per-layer-bucket collectives inside the
+        # backward scan vs the monolithic after-backward sync.  Inert
+        # (engine warns) on a single chip; must divide n_layer.
+        ek["grad_buckets"] = int(grad_buckets)
     if n_chips == 1:
         engine = SingleDevice(model, opt, mesh=mesh, **ek)
     else:
@@ -596,6 +608,9 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             **({"grad_comm": grad_comm,
                 "grad_comm_active": bool(engine._grad_comm_active)}
                if grad_comm else {}),
+            **({"grad_buckets": int(grad_buckets),
+                "grad_buckets_active": bool(engine._bucketed_active)}
+               if grad_buckets else {}),
             "effective": {
                 "remat": str(cfg.remat),
                 "fused_xent": str(cfg.fused_xent),

@@ -179,6 +179,15 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "across groups (M must divide the data-axis size)",
     )
     p.add_argument(
+        "--grad-buckets", type=int, default=1, metavar="K",
+        help="bucketed backward-overlapped gradient release: split the "
+             "gradient into K layer buckets (+ a non-block tail) and "
+             "emit each bucket's collective INSIDE the backward scan, "
+             "so its wire time overlaps the remaining backward compute "
+             "(works with --grad-comm fp32/int8/fp8; K must divide "
+             "n_layer; 1 = the monolithic schedule)",
+    )
+    p.add_argument(
         "--fused-xent", choices=("chunked", "pallas"), default=None,
         help="fused lm_head+cross-entropy head: 'chunked' (XLA scan over "
              "(B,chunk,V) slabs) or 'pallas' (round-5 kernel — logit "
@@ -352,6 +361,7 @@ def run(engine_cls, args, single_device=False):
         telemetry=telem,
         grad_comm=getattr(args, "grad_comm", "fp32"),
         grad_comm_groups=getattr(args, "grad_comm_groups", None),
+        grad_buckets=getattr(args, "grad_buckets", 1),
     )
     if single_device:
         engine = engine_cls(

@@ -128,4 +128,16 @@ log "     adjust BENCH_GRAD_COMM_GROUPS to the fast-link group size)"
 timeout 2400 env BENCH_GRAD_COMM=int8 BENCH_GRAD_COMM_GROUPS=2 python bench.py > "$OUT/bench_gradcomm_int8_hier.json" 2> "$OUT/bench_gradcomm_int8_hier.err"
 log "   int8 2-hop rc=$? $(cat "$OUT/bench_gradcomm_int8_hier.json" 2>/dev/null | head -c 160)"
 
+log "16. bucketed backward-overlapped grad release A/B (round-7: grad_buckets="
+log "    per-layer-bucket collectives inside the backward scan vs the"
+log "    monolithic after-backward sync — only meaningful multi-chip; the"
+log "    overlap itself is the latency-hiding scheduler's call, so compare"
+log "    step time, not just the ledger)"
+for gb in 2 4; do
+  timeout 2400 env BENCH_GRAD_COMM=int8 BENCH_GRAD_BUCKETS=$gb python bench.py > "$OUT/bench_gradbuckets_int8_k$gb.json" 2> "$OUT/bench_gradbuckets_int8_k$gb.err"
+  log "   int8 K=$gb rc=$? $(cat "$OUT/bench_gradbuckets_int8_k$gb.json" 2>/dev/null | head -c 160)"
+done
+timeout 2400 env BENCH_GRAD_BUCKETS=4 python bench.py > "$OUT/bench_gradbuckets_fp32_k4.json" 2> "$OUT/bench_gradbuckets_fp32_k4.err"
+log "   fp32 K=4 rc=$? $(cat "$OUT/bench_gradbuckets_fp32_k4.json" 2>/dev/null | head -c 160)"
+
 log "batch complete; results in $OUT"

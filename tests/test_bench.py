@@ -74,6 +74,9 @@ class TestDiagnose:
         assert rec["extra"]["cached_result"] is True
         assert rec["extra"]["measured_commit"]
         assert "live_error" in rec["extra"]
+        # TOP-LEVEL staleness: a substituted cache is not a live
+        # measurement — trajectory tooling must not treat it as fresh
+        assert rec["stale"] is True
 
     def test_cache_ignored_for_non_default_config(self, bench, capsys,
                                                   monkeypatch):
@@ -168,6 +171,7 @@ class TestCache:
         monkeypatch.setenv("BENCH_ATTEMPT", str(bench.MAX_ATTEMPTS))
         rec = _diagnose(bench, RuntimeError("UNAVAILABLE: hung"), capsys)
         assert rec["value"] == 88000.0
+        assert rec["stale"] is True
         assert rec["extra"]["stale_cached_result"] is True
         assert rec["extra"]["age_hours"] >= 14
         assert "note" in rec["extra"]

@@ -99,6 +99,43 @@ class TestQuantPrimitives:
         with pytest.raises(ValueError):
             qcomm.quantize_blockwise(jnp.zeros((256,)), "fp4")
 
+    def test_hier_groups_partition_the_axis(self):
+        """Every rank appears exactly once per hop: intra groups tile the
+        axis in consecutive runs, inter groups stride across them."""
+        intra, inter = qcomm._hier_groups(8, 2)
+        assert intra == [[0, 1], [2, 3], [4, 5], [6, 7]]
+        assert inter == [[0, 2, 4, 6], [1, 3, 5, 7]]
+        for n, inner in ((8, 4), (16, 4), (12, 3)):
+            intra, inter = qcomm._hier_groups(n, inner)
+            assert sorted(x for g in intra for x in g) == list(range(n))
+            assert sorted(x for g in inter for x in g) == list(range(n))
+            assert all(len(g) == inner for g in intra)
+            assert all(len(g) == n // inner for g in inter)
+
+    def test_hier_groups_degenerate_inner(self):
+        """inner=1 (each rank its own group) and inner=n (one group) are
+        the flat schedule's two degenerate factorizations — the callers
+        bypass them (quantized_reduce_scatter treats them as flat), and
+        piece_owner maps both to the identity."""
+        intra, inter = qcomm._hier_groups(8, 1)
+        assert intra == [[i] for i in range(8)]
+        assert inter == [list(range(8))]
+        intra, inter = qcomm._hier_groups(8, 8)
+        assert intra == [list(range(8))]
+        assert inter == [[i] for i in range(8)]
+        assert qcomm.piece_owner(8, 1).tolist() == list(range(8))
+        assert qcomm.piece_owner(8, 8).tolist() == list(range(8))
+
+    def test_hier_groups_non_divisor_raises(self):
+        """n % inner != 0 must raise, not silently drop the remainder
+        ranks from every group."""
+        with pytest.raises(ValueError, match="must divide"):
+            qcomm._hier_groups(8, 3)
+        with pytest.raises(ValueError, match="must divide"):
+            qcomm._hier_groups(8, 0)
+        with pytest.raises(ValueError, match="must divide"):
+            qcomm.piece_owner(8, 5)
+
     @pytest.mark.parametrize("mode,stochastic", [
         ("int8", False), ("int8", True), ("fp8", False),
     ])

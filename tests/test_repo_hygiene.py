@@ -1,0 +1,56 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Repo hygiene gates: build artifacts must never be tracked.
+
+A committed `__pycache__` .pyc once rode along with a PR; these tests make
+that class of regression fail CI instead of relying on reviewer eyes.
+Skipped (not failed) when the checkout has no git metadata (sdist/tarball
+installs)."""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tracked-path fragments that are always build artifacts, never source
+_ARTIFACT_MARKERS = ("__pycache__",)
+_ARTIFACT_SUFFIXES = (".pyc", ".pyo", ".pyd")
+
+
+def _tracked_files():
+    if not os.path.isdir(os.path.join(REPO, ".git")):
+        pytest.skip("not a git checkout (no .git directory)")
+    try:
+        out = subprocess.run(
+            ["git", "ls-files"], cwd=REPO, capture_output=True,
+            text=True, timeout=30,
+        )
+    except FileNotFoundError:
+        pytest.skip("git unavailable")
+    if out.returncode != 0:
+        pytest.skip(f"git ls-files failed: {out.stderr[:200]}")
+    return out.stdout.splitlines()
+
+
+def test_no_tracked_bytecode_artifacts():
+    bad = [
+        p for p in _tracked_files()
+        if any(m in p for m in _ARTIFACT_MARKERS)
+        or p.endswith(_ARTIFACT_SUFFIXES)
+    ]
+    assert not bad, (
+        f"tracked build artifacts: {bad} — `git rm --cached` them; "
+        ".gitignore already excludes __pycache__/ and *.pyc"
+    )
+
+
+def test_gitignore_covers_bytecode():
+    """The .gitignore entries the tracked-artifact gate relies on must
+    stay present (removing them re-opens the accidental-add path)."""
+    with open(os.path.join(REPO, ".gitignore")) as f:
+        lines = {ln.strip() for ln in f}
+    assert "__pycache__/" in lines
+    assert "*.pyc" in lines

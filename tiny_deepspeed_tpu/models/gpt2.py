@@ -208,6 +208,11 @@ class GPT2Model:
     # apply() implements the GPipe pipeline path (pctx.pipe_parallel);
     # subclasses that override apply() without it must reset this flag
     pipeline_capable = True
+    # apply() threads the engine's bucketed grad-release tap
+    # (parallel/comm.GradBucketTap) through the layer scan; subclasses
+    # that override apply() without the grad_tap branch must reset this
+    # (MoEGPT does — its scan carries the aux-loss accumulator)
+    grad_bucket_capable = True
 
     def __init__(self, config: GPTConfig):
         self.config = config
@@ -683,7 +688,7 @@ class GPT2Model:
         return logits.astype(jnp.float32)
 
     def apply(self, params, idx, targets: Optional[jax.Array] = None,
-              pctx=None, position=None, rng=None):
+              pctx=None, position=None, rng=None, grad_tap=None):
         """Forward pass.  Returns mean loss if targets given, else logits —
         same contract as reference GPT2Model.forward (model.py:139-157).
 
@@ -693,11 +698,27 @@ class GPT2Model:
 
         `rng` (train-time only) enables dropout when config.dropout > 0:
         one key per layer rides the stacked scan tree, so the same masks
-        are recomputed bit-exactly by the remat backward."""
+        are recomputed bit-exactly by the remat backward.
+
+        `grad_tap` (parallel/comm.GradBucketTap, engine grad_buckets > 1)
+        replaces the plain layer scan with the bucketed one: layers run
+        in K groups and each group's stacked-param slice passes through
+        the tap's identity custom_vjp, so the backward scan body emits
+        that bucket's gradient collective as soon as its grads are final.
+        None (default) keeps the exact single-scan program."""
         x = self.embed(params, idx, pctx)
         stacked = self.stacked_compute_params(params)
         stacked, x = self._dropout_setup(stacked, x, rng)
         block = self.block_fn(pctx)
+
+        if grad_tap is not None:
+            if pctx is not None and pctx.pipe_parallel:
+                raise ValueError(
+                    "grad_tap does not compose with the pipeline forward"
+                )
+            x = grad_tap.scan(block, stacked, x,
+                              unroll=self.config.scan_unroll)
+            return self.head(params, x, targets, pctx, position)
 
         if pctx is not None and pctx.pipe_parallel:
             # GPipe-style SPMD pipeline over the "pipe" axis: each stage owns

@@ -101,6 +101,10 @@ class MoEGPT(GPT2Model):
     # apply() carries the aux load-balance loss through the scan AND through
     # the GPipe pipeline (spmd_pipeline with_aux: bubble ticks masked)
     pipeline_capable = True
+    # apply() below re-implements the layer scan with the aux-loss
+    # accumulator in the carry and does not thread the engine's bucketed
+    # grad-release tap; the engine rejects grad_buckets > 1 for it
+    grad_bucket_capable = False
     # 1F1B (round 3): the aux loss joins as a constant-cotangent second
     # output of the layer slab (pipeline.py with_aux), so MoE runs the
     # O(S)-memory schedule too

@@ -145,7 +145,15 @@ def _dequant_rows(q, s):
 def _hier_groups(n: int, inner: int):
     """(intra, inter) axis_index_groups for n = G*inner consecutive-rank
     groups: intra = the inner-sized groups (hop 1, low precision), inter =
-    same-local-rank members across groups (hop 2, bf16)."""
+    same-local-rank members across groups (hop 2, bf16).  `inner` must be
+    a divisor of n — the engine validates its knob, but the schedule
+    helpers validate too so a direct caller cannot silently build groups
+    that drop ranks."""
+    if inner < 1 or n % inner:
+        raise ValueError(
+            f"hierarchical inner group size {inner} must divide the "
+            f"axis size {n}"
+        )
     g_outer = n // inner
     intra = [[g * inner + j for j in range(inner)] for g in range(g_outer)]
     inter = [[g * inner + j for g in range(g_outer)] for j in range(inner)]
@@ -160,6 +168,11 @@ def piece_owner(n: int, inner: Optional[int]) -> np.ndarray:
     gid*inner + lid."""
     if not inner or inner in (1, n):
         return np.arange(n)
+    if n % inner:
+        raise ValueError(
+            f"hierarchical inner group size {inner} must divide the "
+            f"axis size {n}"
+        )
     g_outer = n // inner
     p = np.arange(n)
     gid, lid = p % g_outer, p // g_outer
@@ -289,6 +302,147 @@ def quantized_grad_sync(grads, residual, axis: str, n: int, mode: str, *,
         )
         off += sz
     return jax.tree.unflatten(treedef, out_leaves), new_residual
+
+
+# ---------------------------------------------------------------------------
+# bucketed backward-overlapped release (engine grad_buckets=, ISSUE 3)
+# ---------------------------------------------------------------------------
+
+def bucket_layout(shapes, n_layer: int, n_buckets: int, n_dev: int,
+                  block: int = DEFAULT_BLOCK) -> dict:
+    """Static geometry of the bucketed gradient release.
+
+    The stacked "h.*" leaves are chunked into `n_buckets` groups of
+    n_layer/n_buckets consecutive layers (every layer carries the same
+    per-layer parameter count, so equal layer counts ARE size-balanced
+    buckets), and the non-block leaves (wte/wpe/ln_f/lm_head) form the
+    tail bucket — their grads finalize only once the whole backward is
+    done (wte last of all), so there is no overlap window to chase for
+    them.  `bucket_pad`/`tail_pad` are the per-bucket padded flat sizes
+    the quantized schedule and the error-feedback residual slices use;
+    the residual row is laid out [bucket 0 | ... | bucket K-1 | tail]."""
+    if n_buckets < 1:
+        raise ValueError(f"grad_buckets must be >= 1, got {n_buckets}")
+    if n_layer % n_buckets:
+        raise ValueError(
+            f"grad_buckets={n_buckets} must divide n_layer={n_layer} "
+            "(equal layers per bucket is what keeps the buckets "
+            "size-balanced and the scan body uniform)"
+        )
+    block_elems = sum(
+        int(np.prod(s.shape)) for n, s in shapes.items()
+        if n.startswith("h.")
+    )
+    tail_elems = sum(
+        int(np.prod(s.shape)) for n, s in shapes.items()
+        if not n.startswith("h.")
+    )
+    per_bucket = block_elems // n_buckets
+    bucket_pad = padded_size(per_bucket, n_dev, block)
+    tail_pad = padded_size(tail_elems, n_dev, block) if tail_elems else 0
+    return {
+        "n_buckets": n_buckets,
+        "layers_per_bucket": n_layer // n_buckets,
+        "bucket_elems": per_bucket,
+        "bucket_pad": bucket_pad,
+        "tail_elems": tail_elems,
+        "tail_pad": tail_pad,
+        "tail_names": sorted(
+            n for n in shapes if not n.startswith("h.")
+        ),
+        "residual_len": n_buckets * bucket_pad + tail_pad,
+    }
+
+
+def _make_tap(reduce_fn):
+    """Identity-forward custom_vjp whose BACKWARD runs `reduce_fn` on the
+    cotangent: `reduce_fn(grad_chunk_tree, extras) -> (reduced_chunk_tree,
+    extras_cotangent)`.  The reduced tree must match the chunk's leaf
+    dtypes exactly (custom_vjp checks the bwd output against the primal
+    avals); the extras cotangent is the smuggling channel — e.g. the new
+    error-feedback residual rides out of the backward as the "gradient"
+    of the residual slice that rode in."""
+    @jax.custom_vjp
+    def tap(chunk, extras):
+        return chunk
+
+    def fwd(chunk, extras):
+        return chunk, extras
+
+    def bwd(extras, g):
+        return reduce_fn(g, extras)
+
+    tap.defvjp(fwd, bwd)
+    return tap
+
+
+class GradBucketTap:
+    """Per-bucket gradient release inside the model's layer scan.
+
+    Built by the engine INSIDE its shard_map manual region over the data
+    axis and handed to `model.apply(..., grad_tap=self)`.  The model's
+    layer loop calls `scan(block, stacked, x, unroll=...)`: the stacked
+    (L, ...) leaves reshape to (K, L/K, ...), an outer lax.scan runs over
+    the K buckets with the layer scan inside, and each bucket's param
+    slice passes through an identity `custom_vjp` whose backward runs
+    this bucket's gradient collective.  That places the reduce for bucket
+    k INSIDE the backward scan body — issued while buckets k-1..0 still
+    have backward compute in flight for XLA's latency-hiding scheduler /
+    collective pipeliner to overlap — the reference's per-parameter
+    backward-hook all-reduce (reference ddp/module.py:36-78) and its
+    unshipped "communication bucketing" TODO (reference README.md:66-71),
+    expressed in XLA terms.
+
+    `extras` is a dict of per-bucket float32 side inputs, every leaf with
+    leading dim K, sliced by the outer scan and fed through the tap:
+
+      "res"  — (K, bucket_pad) error-feedback residual slices; the tap's
+               cotangent for it IS the new residual (smuggled out of the
+               backward through the vjp).
+      "acc"  — accumulated-gradient prefix chunks (grad accumulation:
+               the first A-1 microbatches sum locally, the final
+               microbatch's taps add the prefix before the one collective
+               per bucket).
+      "rng"  — stochastic-rounding key rows BITCAST to f32 (an integer
+               tap input would need a float0 cotangent; a 2-word bitcast
+               keeps the tap all-float).
+
+    Integer leaves of the stacked tree itself (the per-layer dropout
+    keys) stay OUTSIDE the tap for the same float0 reason."""
+
+    def __init__(self, n_buckets: int, reduce_fn, extras=None):
+        self.n_buckets = int(n_buckets)
+        self._tap = _make_tap(reduce_fn)
+        self.extras = extras or {}
+
+    def scan(self, block, stacked, x, unroll=1):
+        """Drop-in replacement for the model's plain layer scan: same
+        (x, stacked) -> x contract, buckets of layers instead of single
+        layers as the outer iteration."""
+        k = self.n_buckets
+
+        def resh(a):
+            return a.reshape((k, a.shape[0] // k) + a.shape[1:])
+
+        stacked_b = jax.tree.map(resh, stacked)
+
+        def bucket_body(carry, xs):
+            bp, ex = xs
+            tappable = {
+                n: v for n, v in bp.items()
+                if jnp.issubdtype(v.dtype, jnp.floating)
+            }
+            tapped = self._tap(tappable, ex)
+            bp = dict(bp, **tapped)
+
+            def layer(c, lp):
+                return block(c, lp), None
+
+            c, _ = jax.lax.scan(layer, carry, bp, unroll=unroll)
+            return c, None
+
+        x, _ = jax.lax.scan(bucket_body, x, (stacked_b, self.extras))
+        return x
 
 
 # ---------------------------------------------------------------------------

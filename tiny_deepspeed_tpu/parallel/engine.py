@@ -234,6 +234,7 @@ class ZeroEngine:
         grad_comm_block: int = 256,
         grad_comm_groups: Optional[int] = None,
         grad_comm_error_feedback: bool = True,
+        grad_buckets: int = 1,
     ):
         """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
         tokens shard over it and attention runs as a ppermute ring
@@ -307,6 +308,35 @@ class ZeroEngine:
         wire-vs-memory trade qgZ makes; keep fp32 when grad memory, not
         interconnect, is the binding constraint.  Inert (warning) on a
         1-device data axis.
+
+        grad_buckets: bucketed backward-overlapped gradient release
+        (parallel/comm.GradBucketTap).  With K > 1 the gradient is split
+        into K size-balanced buckets of consecutive layers (the stacked
+        "h.*" leaves; K must divide n_layer) plus a tail bucket for the
+        non-block leaves, and each layer bucket's collective — fp32
+        pmean or the grad_comm int8/fp8 quantized schedule with
+        per-bucket error-feedback residual slices — is emitted INSIDE
+        the backward scan body via an identity custom_vjp on the bucket's
+        param slice, as soon as that bucket's grads are final.  XLA's
+        latency-hiding scheduler can then overlap bucket k's wire time
+        with buckets k-1..0's backward compute — the reference's
+        per-parameter backward-hook all-reduce (ddp/module.py:36-78) and
+        its unshipped "communication bucketing" TODO (README.md:66-71).
+        The monolithic schedule serializes ALL gradient wire behind the
+        full backward; `utils/hlo_comm.overlap_report` measures the
+        difference off the compiled HLO (the `grad_comm_overlap_frac`
+        telemetry gauge).  grad_buckets=1 (default) keeps the exact
+        monolithic program (byte-identical, pinned by
+        tests/test_grad_buckets.py).  Same mesh contract as quantized
+        grad_comm (pure data-parallel, stages 0-2, model replayed with
+        pctx=None inside a shard_map over the data axis) — plus the
+        model must be grad_bucket_capable (GPT-2/Llama; MoE's scan
+        carries an aux accumulator and is not) and gather_quant must be
+        off (f8 stacked leaves would put e4m3 cotangents on the wire
+        path).  Composes with grad_comm modes, accumulation (buckets
+        fire only on the final microbatch, the accumulated prefix rides
+        into the taps), grad clip, loss scaling, and telemetry.  Inert
+        (warning) on a 1-device data axis.
 
         offload_opt_state: ZeRO-Offload-style placement — optimizer
         moments REST in host memory (NamedSharding memory_kind
@@ -505,6 +535,55 @@ class ZeroEngine:
                     f"of the data-axis size {self.n_shard} (>= 2)"
                 )
 
+        # bucketed backward-overlapped gradient release (grad_buckets=):
+        # same explicit-schedule mesh contract as quantized grad_comm,
+        # plus the model must thread the tap through its layer scan
+        self.grad_buckets = int(grad_buckets) if grad_buckets else 1
+        if self.grad_buckets < 1:
+            raise ValueError(
+                f"grad_buckets must be >= 1, got {grad_buckets}"
+            )
+        self._bucketed_active = (
+            self.grad_buckets > 1 and self.data_parallel
+            and self.n_shard > 1
+        )
+        if self.grad_buckets > 1:
+            if self.stage >= 3:
+                raise ValueError(
+                    "grad_buckets supports stages 0-2 (ZeRO-3 params "
+                    "rest sharded; the local-grad shard_map would need "
+                    "per-layer gathers inside the manual region)"
+                )
+            busy = [ax for ax in (self.seq_axis, self.model_axis,
+                                  self.expert_axis, self.pipe_axis)
+                    if ax is not None]
+            if busy:
+                raise ValueError(
+                    f"grad_buckets needs a pure data-parallel mesh (the "
+                    f"local-grad shard_map replays the model with "
+                    f"pctx=None); active axes: {busy}"
+                )
+            if not getattr(model, "grad_bucket_capable", False):
+                raise ValueError(
+                    f"{type(model).__name__} does not thread the bucketed "
+                    "grad-release tap through its layer scan "
+                    "(grad_bucket_capable=False)"
+                )
+            if getattr(getattr(model, "config", None), "gather_quant",
+                       None):
+                raise ValueError(
+                    "grad_buckets does not compose with gather_quant "
+                    "(the f8 stacked leaves' cotangents would reach the "
+                    "bucket collectives in e4m3)"
+                )
+            if not self._bucketed_active:
+                warnings.warn(
+                    f"grad_buckets={self.grad_buckets} is inert on a "
+                    "1-device data axis (there is no gradient collective "
+                    "to overlap); running the monolithic path",
+                    stacklevel=2,
+                )
+
         shapes = model.param_shapes()
         # API-parity ownership table (the reference's cache rank map).
         self.rank_map = partition_tensors(
@@ -654,14 +733,33 @@ class ZeroEngine:
         # error-feedback residual: per-device flat error, global shape
         # (n_shard, padded_elems) sharded over the data axis — each rank's
         # row is ITS quantization error (parallel/comm.py docstring)
+        # bucketed-release geometry: layer-bucket / tail-pad sizes and the
+        # residual layout (raises here, at init, when grad_buckets does
+        # not divide n_layer)
+        self._bucket_layout = None
+        if self._bucketed_active:
+            from .comm import bucket_layout
+            stack_dims = [s.shape[0] for nm, s in shapes.items()
+                          if nm.startswith("h.")]
+            if not stack_dims:
+                raise ValueError(
+                    "grad_buckets needs a stacked-block model (no 'h.*' "
+                    "leaves to bucket by layer)"
+                )
+            self._bucket_layout = bucket_layout(
+                shapes, stack_dims[0], self.grad_buckets, self.n_shard,
+                self.grad_comm_block,
+            )
         self._residual_shardings = None
         self._residual_shape = None
         if self._grad_comm_active and self.grad_comm_error_feedback:
-            total = sum(int(np.prod(s.shape)) for s in shapes.values())
-            self._residual_shape = (
-                self.n_shard,
-                padded_size(total, self.n_shard, self.grad_comm_block),
-            )
+            if self._bucket_layout is not None:
+                # per-bucket residual slices: [b0 | ... | bK-1 | tail]
+                pad = self._bucket_layout["residual_len"]
+            else:
+                total = sum(int(np.prod(s.shape)) for s in shapes.values())
+                pad = padded_size(total, self.n_shard, self.grad_comm_block)
+            self._residual_shape = (self.n_shard, pad)
             self._residual_shardings = NamedSharding(mesh, P("data"))
         self._dropout_shardings = (
             NamedSharding(mesh, P()) if self._dropout_active else None
@@ -1002,6 +1100,263 @@ class ZeroEngine:
             return out
         return out[0], out[1], None
 
+    def _bucketed_loss_and_grads(self, state, idx, targets, rng, scale):
+        """The grad_buckets > 1 gradient phase: per-bucket release inside
+        the backward scan (parallel/comm.GradBucketTap).
+
+        Like _quant_loss_and_grads, everything runs inside a shard_map
+        over the data axis with the model replayed pctx=None (replicated
+        params, local batch shard).  The K layer buckets reduce INSIDE
+        the backward scan body — the tap's custom_vjp emits each bucket's
+        collective as soon as that bucket's grads are final, while
+        earlier buckets' backward compute is still in flight for the
+        scheduler to hide the wire behind.  The non-block tail
+        (wte/wpe/ln_f/lm_head) reduces once after value_and_grad: its
+        grads finalize only when the whole backward is over (wte last of
+        all), so there is no window to chase.
+
+        grad_comm="fp32" buckets pmean in compute dtype (what the GSPMD
+        all-reduce moves — comm_report round-4 finding); int8/fp8 buckets
+        run the quantized schedule with per-bucket error-feedback
+        residual slices laid out [b0 | ... | bK-1 | tail] in
+        TrainState.grad_residual (the new residual is smuggled out of the
+        backward as the tap's cotangent for the slice that rode in).
+        Microbatches accumulate LOCALLY and the buckets fire only on the
+        final microbatch — the accumulated prefix rides into the taps as
+        the "acc" extra, so the one collective per bucket reduces the
+        full mean gradient.
+
+        Returns (loss scaled+replicated, grads reduced/UNSCALED in param
+        dtypes, new (n, pad) residual or None)."""
+        from . import comm as qcomm
+
+        n = self.n_shard
+        mode = self.grad_comm
+        blk = self.grad_comm_block
+        inner = self.grad_comm_groups
+        accum = self.accum_steps
+        kb = self.grad_buckets
+        lay = self._bucket_layout
+        bpad = lay["bucket_pad"]
+        lb = lay["layers_per_bucket"]
+        tail_names = lay["tail_names"]
+        params = state.params
+        residual = state.grad_residual
+        model = self.model
+        cd = getattr(
+            getattr(model, "config", None), "compute_dtype", jnp.float32
+        )
+        qkey = None
+        if mode == "int8":
+            qkey = jax.random.fold_in(
+                jax.random.PRNGKey(0x6C51), state.opt_state["step"]
+            )
+        has_res, has_rng = residual is not None, rng is not None
+        has_qk, has_sc = qkey is not None, scale is not None
+
+        def local(p, ix, tg, *rest):
+            rest = list(rest)
+            res = rest.pop(0) if has_res else None
+            r = rest.pop(0) if has_rng else None
+            qk = rest.pop(0) if has_qk else None
+            sc = rest.pop(0) if has_sc else None
+            di = jax.lax.axis_index("data")
+            if r is not None:
+                r = jax.random.fold_in(r, di)
+            if qk is not None:
+                qk = jax.random.fold_in(qk, di)
+            res_row = res[0] if res is not None else None
+            bres = res_row[: kb * bpad] if res_row is not None else None
+            tres = res_row[kb * bpad:] if res_row is not None else None
+            bkeys = tkey = None
+            if qk is not None:
+                keys = jax.random.split(qk, kb + 1)
+                # per-bucket stochastic-rounding keys ride through the tap
+                # bitcast to f32 (integer tap inputs would need float0
+                # cotangents); the tail keeps its key directly
+                bkeys = jax.lax.bitcast_convert_type(
+                    keys[:kb], jnp.float32
+                )
+                tkey = keys[kb]
+
+            def bucket_reduce(g, ex):
+                """Tap backward: ONE bucket's collective, emitted inside
+                the backward scan body."""
+                ex_cot = {}
+                gf = jax.tree.map(lambda a: a.astype(jnp.float32), g)
+                if "acc" in ex:
+                    # final microbatch: fold in the locally-accumulated
+                    # prefix so the single sync reduces the full mean grad
+                    gf = jax.tree.map(
+                        lambda a, b: (a + b) / accum, gf, ex["acc"]
+                    )
+                    ex_cot["acc"] = jax.tree.map(
+                        jnp.zeros_like, ex["acc"]
+                    )
+                if "scale" in ex:
+                    # unscale BEFORE the sync: the residual must carry
+                    # true gradient units (the _quant_loss_and_grads
+                    # rule).  The scale rides the extras rather than the
+                    # closure — a custom_vjp bwd rule must not capture
+                    # tracers
+                    gf = jax.tree.map(
+                        lambda a: a * (1.0 / ex["scale"]), gf
+                    )
+                    ex_cot["scale"] = jnp.zeros_like(ex["scale"])
+                key = None
+                if "rng" in ex:
+                    key = jax.lax.bitcast_convert_type(
+                        ex["rng"], jnp.uint32
+                    )
+                    ex_cot["rng"] = jnp.zeros_like(ex["rng"])
+                if mode == "fp32":
+                    # compute-dtype pmean: the same bytes the GSPMD
+                    # all-reduce moves (it commutes the reduction with
+                    # the grad's f32 cast — comm_report round-4)
+                    red = jax.tree.map(
+                        lambda a, o: jax.lax.pmean(
+                            a.astype(o.dtype), "data"
+                        ), gf, g,
+                    )
+                else:
+                    red, new_r = qcomm.quantized_grad_sync(
+                        gf, ex.get("res"), "data", n, mode, block=blk,
+                        rng=key, inner=inner,
+                    )
+                    if "res" in ex:
+                        ex_cot["res"] = new_r
+                red = jax.tree.map(
+                    lambda a, o: a.astype(o.dtype), red, g
+                )
+                return red, ex_cot
+
+            def tapped_loss(p_, bres_, ix_, tg_, r_, acc=None):
+                extras = {}
+                if bres_ is not None:
+                    extras["res"] = bres_.reshape(kb, bpad)
+                if acc is not None:
+                    extras["acc"] = acc
+                if bkeys is not None:
+                    extras["rng"] = bkeys
+                if sc is not None:
+                    extras["scale"] = jnp.full((kb,), sc, jnp.float32)
+                tap = qcomm.GradBucketTap(kb, bucket_reduce, extras)
+                kw = {"rng": r_} if r_ is not None else {}
+                loss = model.apply(
+                    p_, ix_, tg_, pctx=None, grad_tap=tap, **kw
+                )
+                return loss * sc if sc is not None else loss
+
+            def run_final(ix_, tg_, r_, acc=None):
+                if bres is not None:
+                    loss_l, (gp, new_b) = jax.value_and_grad(
+                        tapped_loss, argnums=(0, 1)
+                    )(p, bres, ix_, tg_, r_, acc)
+                else:
+                    loss_l, gp = jax.value_and_grad(tapped_loss)(
+                        p, None, ix_, tg_, r_, acc
+                    )
+                    new_b = None
+                return loss_l, gp, new_b
+
+            if accum == 1:
+                loss_l, gp, new_bres = run_final(ix, tg, r)
+            else:
+                def body(carry, mb):
+                    al, ag = carry
+                    ix_, tg_, mb_i = mb
+                    mb_r = (jax.random.fold_in(r, mb_i)
+                            if r is not None else None)
+
+                    def plain(p_, ix2, tg2, r2):
+                        kw = {"rng": r2} if r2 is not None else {}
+                        loss = model.apply(p_, ix2, tg2, pctx=None, **kw)
+                        return loss * sc if sc is not None else loss
+
+                    l, g_ = jax.value_and_grad(plain)(p, ix_, tg_, mb_r)
+                    ag = jax.tree.map(
+                        lambda a, b: a + b.astype(jnp.float32), ag, g_
+                    )
+                    return (al + l, ag), None
+
+                zg = jax.tree.map(
+                    lambda q: jnp.zeros(q.shape, jnp.float32), p
+                )
+                (al, ag), _ = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), zg),
+                    (ix[:-1], tg[:-1], jnp.arange(accum - 1)),
+                )
+                # accumulated h.* prefix, chunked (K, L/K, ...) under the
+                # STACKED-tree keys the taps see
+                acc_blocks = {
+                    nm[len("h."):]: ag[nm].reshape(
+                        (kb, lb) + ag[nm].shape[1:]
+                    )
+                    for nm in ag if nm.startswith("h.")
+                }
+                mb_r = (jax.random.fold_in(r, accum - 1)
+                        if r is not None else None)
+                loss_f, gp, new_bres = run_final(
+                    ix[-1], tg[-1], mb_r, acc=acc_blocks
+                )
+                loss_l = (al + loss_f) / accum
+                gp = dict(gp)
+                for nm in tail_names:
+                    # the taps folded the prefix in for h.*; the tail
+                    # leaves get it here, before their own sync below
+                    gp[nm] = (
+                        (ag[nm] + gp[nm].astype(jnp.float32)) / accum
+                    ).astype(gp[nm].dtype)
+
+            # tail bucket: one sync after the backward completes
+            tail = {
+                nm: gp[nm].astype(jnp.float32) for nm in tail_names
+            }
+            if sc is not None:
+                tail = jax.tree.map(lambda a: a * (1.0 / sc), tail)
+            if mode == "fp32":
+                tail_red = jax.tree.map(
+                    lambda a: jax.lax.pmean(a.astype(cd), "data"), tail
+                )
+                new_tres = None
+            else:
+                tail_red, new_tres = qcomm.quantized_grad_sync(
+                    tail, tres, "data", n, mode, block=blk, rng=tkey,
+                    inner=inner,
+                )
+            gp = dict(gp)
+            for nm in tail_names:
+                gp[nm] = tail_red[nm]
+            grads = jax.tree.map(
+                lambda a, q: a.astype(q.dtype), gp, params
+            )
+            outs = [jax.lax.pmean(loss_l, "data"), grads]
+            if has_res:
+                outs.append(jnp.concatenate([new_bres, new_tres])[None])
+            return tuple(outs)
+
+        pspec = jax.tree.map(lambda _: P(), params)
+        bspec = P(None, "data") if accum > 1 else P("data")
+        in_specs = [pspec, bspec, bspec]
+        args = [params, idx, targets]
+        for cond, spec, val in (
+            (has_res, P("data"), residual), (has_rng, P(), rng),
+            (has_qk, P(), qkey), (has_sc, P(), scale),
+        ):
+            if cond:
+                in_specs.append(spec)
+                args.append(val)
+        out_specs = [P(), jax.tree.map(lambda _: P(), params)]
+        if has_res:
+            out_specs.append(P("data"))
+        out = jax.shard_map(
+            local, mesh=self.mesh, in_specs=tuple(in_specs),
+            out_specs=tuple(out_specs), check_vma=False,
+        )(*args)
+        if has_res:
+            return out
+        return out[0], out[1], None
+
     def _step_impl(self, state: "TrainState", batch):
         # trace-time marker: on a multi-device mesh this program is GSPMD
         # auto-partitioned, so naked Mosaic custom calls cannot lower —
@@ -1046,7 +1401,15 @@ class ZeroEngine:
             return jax.value_and_grad(loss_fn)(p, ix, tg, rng)
 
         new_residual = state.grad_residual
-        if self._grad_comm_active:
+        if self._bucketed_active:
+            # bucketed backward-overlapped release (grad_buckets > 1):
+            # per-bucket collectives emitted inside the backward scan
+            # body, fp32 or quantized.  Grads come back reduced and
+            # UNSCALED, like the quantized path below.
+            loss, grads, new_residual = self._bucketed_loss_and_grads(
+                state, idx, targets, rng, scale
+            )
+        elif self._grad_comm_active:
             # quantized gradient collectives (parallel/comm.py): local
             # grads inside a shard_map over the data axis, explicit
             # error-feedback int8/fp8 reduce-scatter + all-gather.  Grads
@@ -1113,7 +1476,7 @@ class ZeroEngine:
 
         if scale is not None:
             loss = loss / scale
-            if not self._grad_comm_active:
+            if not (self._grad_comm_active or self._bucketed_active):
                 grads = _rescale(grads, 1.0 / scale)
         if dynamic:
             # finiteness judged on the UNSCALED grads, before clipping can
@@ -1240,6 +1603,8 @@ class ZeroEngine:
                 extras += f"(2-hop inner={self.grad_comm_groups})"
             if not self.grad_comm_error_feedback:
                 extras += "(no-ef)"
+        if self._bucketed_active:
+            extras += f", grad_buckets={self.grad_buckets}"
         return (
             f"{name}(stage={self.stage}, devices={self.n_dev}, "
             f"accum={self.accum_steps}, params sharded="

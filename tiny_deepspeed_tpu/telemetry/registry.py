@@ -291,18 +291,31 @@ class Telemetry:
         SPMD HLO (utils/hlo_comm.py), next to the ring-model `comm_report`
         prediction — plus the AOT memory analysis when the backend
         provides one."""
-        from ..utils.hlo_comm import collective_ledger, ledger_summary
+        from ..utils.hlo_comm import (
+            collective_ledger, ledger_summary, overlap_report,
+        )
 
         engine = engine or self._engine
         if engine is None:
             raise ValueError("no engine attached; pass engine=")
         compiled = engine._step.lower(state, batch).compile()
-        measured = ledger_summary(collective_ledger(compiled.as_text()))
+        compiled_text = compiled.as_text()
+        led = collective_ledger(compiled_text)
+        measured = ledger_summary(led)
         model_rep = comm_report(engine)
+        # overlap window: how much of the reducing-collective wire is
+        # issued inside while bodies (before the backward scan completes)
+        # — the measured counterpart of the grad_buckets knob.  Reuses
+        # the ledger above; only the async-window scan re-reads the text
+        overlap = overlap_report(compiled_text, led=led)
         out: Dict[str, object] = {
             "comm_measured": measured,
             "comm_model": model_rep,
+            "comm_overlap": overlap,
         }
+        self.gauge(
+            "grad_comm_overlap_frac", overlap["grad_comm_overlap_frac"]
+        )
         modeled = float(model_rep.get("total_bytes_per_step", 0.0))
         if modeled > 0:
             out["comm_delta"] = round(

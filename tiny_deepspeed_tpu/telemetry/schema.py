@@ -66,6 +66,10 @@ META_FIELDS: Dict[str, tuple] = {
     "comm_model": dict,
     "comm_measured": dict,
     "comm_delta": _NUM,
+    # overlap-window analysis (utils/hlo_comm.overlap_report): loop-
+    # resident vs top-level reducing-collective wire + async start->done
+    # windows — the measured side of the grad_buckets knob
+    "comm_overlap": dict,
     # quantized grad-collective model (parallel/comm.modeled_wire_bytes):
     # mode, elems_padded, quant vs fp32-all-reduce wire bytes
     "grad_comm": dict,

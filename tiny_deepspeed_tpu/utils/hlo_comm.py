@@ -27,7 +27,7 @@ stage and pins their agreement.
 from __future__ import annotations
 
 import re
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 _DTYPE_BYTES = {
     "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
@@ -347,8 +347,11 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
     wire: Dict[str, float] = {}
     wire_by_dtype: Dict[str, float] = {}
     count: Dict[str, float] = {}
+    wire_in_loops: Dict[str, float] = {}
+    count_in_loops: Dict[str, float] = {}
 
-    def walk(comp: str, mult: float, seen: tuple) -> None:
+    def walk(comp: str, mult: float, seen: tuple,
+             in_loop: bool = False) -> None:
         if comp in seen:  # cycles don't exist in HLO; belt and braces
             return
         for op, b, n, by_dt in local.get(comp, []):
@@ -365,6 +368,14 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
             else:  # all-to-all
                 w = b * (n - 1) / n if n > 1 else 0.0
             wire[op] = wire.get(op, 0.0) + mult * w
+            if in_loop:
+                # a collective INSIDE a while body runs before the loop
+                # finishes — for the backward scan, before the backward
+                # completes; that is the statically-verifiable half of
+                # "the scheduler can hide this wire behind compute"
+                # (overlap_report builds on this split)
+                wire_in_loops[op] = wire_in_loops.get(op, 0.0) + mult * w
+                count_in_loops[op] = count_in_loops.get(op, 0.0) + mult
             if b:
                 # the ring formulas above are linear in the payload, so
                 # the per-dtype wire split is just proportional
@@ -372,8 +383,9 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                     wire_by_dtype[dt] = (
                         wire_by_dtype.get(dt, 0.0) + mult * w * db / b
                     )
-        for child, trips, _kind in edges.get(comp, []):
-            walk(child, mult * trips, seen + (comp,))
+        for child, trips, kind in edges.get(comp, []):
+            walk(child, mult * trips, seen + (comp,),
+                 in_loop or kind.startswith("while"))
 
     if entry is not None:
         walk(entry, 1.0, ())
@@ -383,9 +395,115 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
         "wire_bytes": wire,
         "wire_bytes_by_dtype": wire_by_dtype,
         "count": count,
+        "wire_bytes_in_loops": wire_in_loops,
+        "count_in_loops": count_in_loops,
         "total_wire_bytes": sum(wire.values()),
         "unresolved_loops": unresolved,
         "unresolved_groups": unresolved_groups,
+    }
+
+
+_REDUCE_OPS = ("all-reduce", "reduce-scatter", "all-to-all")
+_START_RE = re.compile(r"%?([\w\.\-]+)\s*=\s*(?:\([^=]*\)|\S+)\s+"
+                       r"((?:all-reduce|all-gather|reduce-scatter|"
+                       r"all-to-all|collective-permute)-start)\(")
+
+
+def async_windows(compiled_text: str) -> List[Dict[str, object]]:
+    """Start→done windows of async collectives in a compiled module.
+
+    For every `<op>-start` instruction, finds the matching `<op>-done`
+    in the same computation (by operand name) and measures the schedule
+    distance: how many instructions sit between issue and completion,
+    and how many of them are compute (fusions / dots / convolutions) the
+    collective's wire time can hide behind — the same style as the
+    offload copy-pair analysis (engine._offload_update docstring: "86/110
+    copy pairs overlap >=1 fusion").  The post-scheduling TPU/GPU HLO is
+    where these pairs appear; the XLA CPU backend emits synchronous
+    collectives, so there this returns [] and the while-body placement
+    split (`overlap_report`) is the portable signal."""
+    out: List[Dict[str, object]] = []
+    for comp, lines in _split_computations(compiled_text).items():
+        starts: Dict[str, Tuple[str, int]] = {}
+        for i, ln in enumerate(lines):
+            m = _START_RE.search(ln)
+            if m:
+                starts[m.group(1)] = (m.group(2)[: -len("-start")], i)
+                continue
+            if "-done(" not in ln:
+                continue
+            for name, (op, i0) in list(starts.items()):
+                # delimited operand match: a bare substring test would
+                # pair %foo-start.1 with %foo-start.12's done line
+                if f" {op}-done(" in ln and re.search(
+                        rf"%{re.escape(name)}\b", ln):
+                    window = lines[i0 + 1: i]
+                    fusions = sum(
+                        1 for w in window
+                        if " fusion(" in w or " dot(" in w
+                        or " convolution(" in w
+                    )
+                    out.append({
+                        "computation": comp,
+                        "op": op,
+                        "name": name,
+                        "distance": i - i0 - 1,
+                        "compute_in_flight": fusions,
+                    })
+                    del starts[name]
+                    break
+    return out
+
+
+def overlap_report(compiled_text: str,
+                   led: Optional[Dict[str, object]] = None
+                   ) -> Dict[str, object]:
+    """Overlap-window analysis of a compiled step's collectives: how much
+    of the gradient wire is issued where the scheduler can hide it.
+
+    Two complementary signals:
+
+      * while-body placement (portable, works on the CPU ledger): a
+        collective inside a while-loop body runs BEFORE the loop — for
+        the backward layer scan, before the backward completes, so its
+        wire time can overlap remaining backward compute.  The monolithic
+        grad_comm schedule puts every gradient byte AFTER the backward
+        (top-level); grad_buckets > 1 moves the layer buckets into the
+        scan body.  `grad_comm_overlap_frac` = loop-resident wire /
+        total wire over the reducing ops (all-reduce, reduce-scatter,
+        all-to-all — the ops a gradient sync lowers to; all-gathers are
+        excluded because ZeRO-3's per-layer WEIGHT gathers are loop-
+        resident by construction and would mask the gradient signal).
+      * async start→done windows (`async_windows`): on post-scheduling
+        TPU/GPU HLO, how many compute ops are actually in flight between
+        a collective's issue and its completion.
+
+    `led` reuses an already-built `collective_ledger` of the same text
+    (the regex computation-graph walk over a multi-MB module is the
+    expensive part; telemetry's capture_compiled passes its own).
+    """
+    if led is None:
+        led = collective_ledger(compiled_text)
+    loop_w = sum(
+        led["wire_bytes_in_loops"].get(op, 0.0) for op in _REDUCE_OPS
+    )
+    total_w = sum(led["wire_bytes"].get(op, 0.0) for op in _REDUCE_OPS)
+    windows = async_windows(compiled_text)
+    return {
+        "reduce_wire_bytes_in_loops": float(loop_w),
+        "reduce_wire_bytes_total": float(total_w),
+        "grad_comm_overlap_frac": float(loop_w / total_w) if total_w
+        else 0.0,
+        "loop_collective_counts": {
+            k: float(v) for k, v in led["count_in_loops"].items()
+        },
+        "async_windows": len(windows),
+        "async_windows_overlapped": sum(
+            1 for w in windows if w["compute_in_flight"] > 0
+        ),
+        "async_window_max_distance": max(
+            (w["distance"] for w in windows), default=0
+        ),
     }
 
 
@@ -403,6 +521,10 @@ def ledger_summary(led: Dict[str, object]) -> Dict[str, object]:
         "wire_bytes_by_dtype": {
             k: float(v)
             for k, v in led.get("wire_bytes_by_dtype", {}).items()
+        },
+        "wire_bytes_in_loops": {
+            k: float(v)
+            for k, v in led.get("wire_bytes_in_loops", {}).items()
         },
         "count": {k: float(v) for k, v in led["count"].items()},
         "total_wire_bytes": float(led["total_wire_bytes"]),

@@ -283,12 +283,41 @@ def comm_report(engine) -> Dict[str, float]:
         n_elems = sum(int(np.prod(s.shape)) for s in shapes.values())
         quant_model = modeled_wire_bytes(
             n_elems, n, engine.grad_comm,
-            block=engine.grad_comm_block, inner=engine.grad_comm_groups,
+            block=engine.grad_comm_block,
+            inner=engine.grad_comm_groups,
         )
+        lay = getattr(engine, "_bucket_layout", None)
+        if lay is not None:
+            # bucketed release (grad_buckets > 1): K layer syncs + one
+            # tail sync, each padded per-bucket — slightly more wire than
+            # the monolithic schedule (the per-bucket padding/scale
+            # overhead the acceptance tolerance prices).  The fp32
+            # all-reduce baseline stays the monolithic model's — ONE
+            # accounting site for the ring convention.
+            qb = modeled_wire_bytes(
+                lay["bucket_elems"], n, engine.grad_comm,
+                block=engine.grad_comm_block,
+                inner=engine.grad_comm_groups,
+            )
+            qt = modeled_wire_bytes(
+                lay["tail_elems"], n, engine.grad_comm,
+                block=engine.grad_comm_block,
+                inner=engine.grad_comm_groups,
+            ) if lay["tail_elems"] else {"elems_padded": 0,
+                                         "quant_wire_bytes": 0.0}
+            k = lay["n_buckets"]
+            quant_model = dict(
+                quant_model,
+                grad_buckets=k,
+                elems_padded=k * qb["elems_padded"] + qt["elems_padded"],
+                quant_wire_bytes=k * qb["quant_wire_bytes"]
+                + qt["quant_wire_bytes"],
+            )
     report = {
         "devices": n,
         "param_bytes": g,
         "grad_comm": getattr(engine, "grad_comm", "fp32"),
+        "grad_buckets": int(getattr(engine, "grad_buckets", 1)),
         # full schedule model kept alongside the headline number so
         # downstream gauges (telemetry capture_compiled) read ONE
         # accounting site instead of re-deriving it
