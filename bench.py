@@ -71,6 +71,9 @@ def _config_fingerprint(env=None) -> str:
         "grad_comm": env.get("BENCH_GRAD_COMM", ""),
         "grad_comm_groups": env.get("BENCH_GRAD_COMM_GROUPS", ""),
         "grad_buckets": env.get("BENCH_GRAD_BUCKETS", ""),
+        "gather_prefetch": env.get("BENCH_GATHER_PREFETCH", ""),
+        "gather_groups": env.get("BENCH_GATHER_GROUPS", ""),
+        "gather_quant": env.get("BENCH_GATHER_QUANT", ""),
     }, sort_keys=True)
 
 
@@ -382,6 +385,32 @@ def _effective_xent_impl(cfg, n_chips: int, tokens=None) -> str:
                                tokens=tokens)
 
 
+def _gather_prefetch_extra(engine, compiled_step, gather_prefetch,
+                           gather_quant):
+    """Round-8 A/B labeling: the gather-prefetch config that actually ran
+    plus the compiled ledger's LOOP-RESIDENT gather wire (the measured
+    placement of the per-layer weight gathers — a hoist regression reads
+    0 here while the step still 'works').  Best effort: a ledger failure
+    must never zero the headline number."""
+    out = {
+        "gather_prefetch": int(gather_prefetch),
+        "gather_prefetch_active": bool(engine._gather_prefetch_active),
+        **({"gather_quant": gather_quant} if gather_quant else {}),
+        **({"gather_groups": int(engine.gather_groups)}
+           if getattr(engine, "gather_groups", None) else {}),
+    }
+    try:
+        from tiny_deepspeed_tpu.utils.hlo_comm import collective_ledger
+        led = collective_ledger(compiled_step.as_text())
+        out["gather_loop_wire_bytes"] = round(
+            led["wire_bytes_in_loops"].get("all-gather", 0.0))
+        out["gather_total_wire_bytes"] = round(
+            led["wire_bytes"].get("all-gather", 0.0))
+    except Exception as e:  # noqa: BLE001 - observability is non-fatal
+        out["gather_ledger_error"] = repr(e)[:160]
+    return out
+
+
 def run_one(model_name: str, b=None, t=1024, iters=30):
     import jax
     import jax.numpy as jnp
@@ -401,6 +430,10 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         # (ops/xent_pallas.py) vs whatever head the config default runs
         cfg = dataclasses.replace(cfg, fused_xent=True,
                                   fused_xent_impl="pallas")
+    gather_quant = os.environ.get("BENCH_GATHER_QUANT")
+    if gather_quant and hasattr(cfg, "gather_quant"):
+        # round-8 A/B axis: fp8 weight gather under the zero3 prefetch A/B
+        cfg = dataclasses.replace(cfg, gather_quant=gather_quant)
     if t > cfg.block_size:
         # long-context invocation (BENCH_SEQ=4096/8192): widen the position
         # table and drop the short-context speed knobs — remat back on and
@@ -450,7 +483,22 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         # backward scan vs the monolithic after-backward sync.  Inert
         # (engine warns) on a single chip; must divide n_layer.
         ek["grad_buckets"] = int(grad_buckets)
-    if n_chips == 1:
+    gather_prefetch = os.environ.get("BENCH_GATHER_PREFETCH")
+    if gather_prefetch:
+        # round-8 A/B knob: ZeRO-3 layer-ahead weight-gather prefetch
+        # (engine gather_prefetch=, parallel/comm.GatherPrefetchScan).
+        # Setting the env var selects the Zero3 engine (the stage whose
+        # per-layer gathers the knob schedules); K=1 is the byte-
+        # identical on-demand baseline so the A/B pair shares a stage.
+        ek["gather_prefetch"] = int(gather_prefetch)
+        if os.environ.get("BENCH_GATHER_GROUPS"):
+            # hierarchical 2-hop gather: inner group size
+            ek["gather_groups"] = int(os.environ["BENCH_GATHER_GROUPS"])
+    if gather_prefetch:
+        from tiny_deepspeed_tpu import Zero3
+        engine = Zero3(model, opt, mesh=mesh, **ek)
+        b *= n_chips
+    elif n_chips == 1:
         engine = SingleDevice(model, opt, mesh=mesh, **ek)
     else:
         from tiny_deepspeed_tpu import Zero2
@@ -611,6 +659,9 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             **({"grad_buckets": int(grad_buckets),
                 "grad_buckets_active": bool(engine._bucketed_active)}
                if grad_buckets else {}),
+            **(_gather_prefetch_extra(engine, compiled_step,
+                                      gather_prefetch, gather_quant)
+               if gather_prefetch else {}),
             "effective": {
                 "remat": str(cfg.remat),
                 "fused_xent": str(cfg.fused_xent),

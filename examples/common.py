@@ -160,10 +160,10 @@ def parse_args(default_model="gpt2-124m", **defaults):
     p.add_argument(
         "--offload-prefetch", type=int, default=2, metavar="W",
         help="with --offload-opt-state: in-flight window of streamed "
-             "moment leaves (minimum 2 — the engine clamps lower values; "
-             "default 2; widening measured peak-HBM cost without "
-             "schedule benefit at leaf granularity — PROFILE.md round-5 "
-             "offload study)",
+             "moment leaves (>= 1; 1 = serial streaming, no double "
+             "buffer; default 2; widening measured peak-HBM cost "
+             "without schedule benefit at leaf granularity — PROFILE.md "
+             "round-5 offload study)",
     )
     p.add_argument(
         "--grad-comm", choices=("fp32", "int8", "fp8"), default="fp32",
@@ -186,6 +186,23 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "so its wire time overlaps the remaining backward compute "
              "(works with --grad-comm fp32/int8/fp8; K must divide "
              "n_layer; 1 = the monolithic schedule)",
+    )
+    p.add_argument(
+        "--gather-prefetch", type=int, default=0, metavar="K",
+        help="ZeRO-3 layer-ahead weight-gather prefetch "
+             "(parallel/comm.GatherPrefetchScan): the block scan issues "
+             "layer k+(K-1)'s parameter all-gather while layer k "
+             "computes, holding at most K layers' gathered weights (2 = "
+             "double buffer), on the forward AND the remat backward; "
+             "composes with --gather-quant fp8.  0/1 = the on-demand "
+             "gather (byte-identical program); zero3 only",
+    )
+    p.add_argument(
+        "--gather-groups", type=int, default=None, metavar="M",
+        help="with --gather-prefetch >= 2: hierarchical 2-hop gather — "
+             "resting precision (f8 under --gather-quant) within M-rank "
+             "groups, compute dtype across groups (mirrors "
+             "--grad-comm-groups; M must divide the data-axis size)",
     )
     p.add_argument(
         "--fused-xent", choices=("chunked", "pallas"), default=None,
@@ -362,6 +379,8 @@ def run(engine_cls, args, single_device=False):
         grad_comm=getattr(args, "grad_comm", "fp32"),
         grad_comm_groups=getattr(args, "grad_comm_groups", None),
         grad_buckets=getattr(args, "grad_buckets", 1),
+        gather_prefetch=getattr(args, "gather_prefetch", 0),
+        gather_groups=getattr(args, "gather_groups", None),
     )
     if single_device:
         engine = engine_cls(

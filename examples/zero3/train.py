@@ -1,7 +1,14 @@
 # Copyright 2026 tiny-deepspeed-tpu authors
 # SPDX-License-Identifier: Apache-2.0
 
-"""ZeRO-3: fully sharded params/grads/optimizer (parity: reference example/zero3/train.py:16-46 - completed here; the reference's is broken, SURVEY 2.18)."""
+"""ZeRO-3: fully sharded params/grads/optimizer (parity: reference
+example/zero3/train.py:16-46 - completed here; the reference's is broken,
+SURVEY 2.18).
+
+Stage-3-specific flags: --gather-prefetch K (layer-ahead weight-gather
+prefetch, K=2 = double buffer; parallel/comm.GatherPrefetchScan),
+--gather-groups M (hierarchical 2-hop gather), --gather-quant fp8
+(ZeRO++-style f8 gathers) — they compose."""
 
 import os
 import sys

@@ -140,4 +140,21 @@ done
 timeout 2400 env BENCH_GRAD_BUCKETS=4 python bench.py > "$OUT/bench_gradbuckets_fp32_k4.json" 2> "$OUT/bench_gradbuckets_fp32_k4.err"
 log "   fp32 K=4 rc=$? $(cat "$OUT/bench_gradbuckets_fp32_k4.json" 2>/dev/null | head -c 160)"
 
+log "17. ZeRO-3 gather-prefetch A/B (round-8: gather_prefetch= layer-ahead"
+log "    weight-gather prefetch, parallel/comm.GatherPrefetchScan — zero3"
+log "    1.5B, fp32 vs fp8 gathers x prefetch off(K=1)/on(K=2); the K=1"
+log "    runs are the byte-identical on-demand baselines on the SAME"
+log "    Zero3 engine.  Only meaningful multi-chip (1 chip = no gathers);"
+log "    extra carries the ledger's loop-resident gather wire bytes)"
+for gp in 1 2; do
+  timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_GATHER_PREFETCH=$gp python bench.py > "$OUT/bench_gatherpf_fp32_k$gp.json" 2> "$OUT/bench_gatherpf_fp32_k$gp.err"
+  log "   fp32 K=$gp rc=$? $(cat "$OUT/bench_gatherpf_fp32_k$gp.json" 2>/dev/null | head -c 160)"
+  timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_GATHER_PREFETCH=$gp BENCH_GATHER_QUANT=fp8 python bench.py > "$OUT/bench_gatherpf_fp8_k$gp.json" 2> "$OUT/bench_gatherpf_fp8_k$gp.err"
+  log "   fp8 K=$gp rc=$? $(cat "$OUT/bench_gatherpf_fp8_k$gp.json" 2>/dev/null | head -c 160)"
+done
+log "17b. hierarchical 2-hop gather (inner group 2 — fp8 intra, bf16 inter;"
+log "     adjust BENCH_GATHER_GROUPS to the fast-link group size)"
+timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_GATHER_PREFETCH=2 BENCH_GATHER_QUANT=fp8 BENCH_GATHER_GROUPS=2 python bench.py > "$OUT/bench_gatherpf_fp8_hier.json" 2> "$OUT/bench_gatherpf_fp8_hier.err"
+log "   fp8 K=2 2-hop rc=$? $(cat "$OUT/bench_gatherpf_fp8_hier.json" 2>/dev/null | head -c 160)"
+
 log "batch complete; results in $OUT"

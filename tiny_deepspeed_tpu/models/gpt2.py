@@ -213,6 +213,11 @@ class GPT2Model:
     # that override apply() without the grad_tap branch must reset this
     # (MoEGPT does — its scan carries the aux-loss accumulator)
     grad_bucket_capable = True
+    # apply() runs the ZeRO-3 layer-ahead prefetched weight-gather scan
+    # (parallel/comm.GatherPrefetchScan) when pctx.gather_prefetch >= 2;
+    # subclasses that override apply() without that branch must reset
+    # this (MoEGPT does — same aux-accumulator scan reason)
+    gather_prefetch_capable = True
 
     def __init__(self, config: GPTConfig):
         self.config = config
@@ -718,6 +723,25 @@ class GPT2Model:
                 )
             x = grad_tap.scan(block, stacked, x,
                               unroll=self.config.scan_unroll)
+            return self.head(params, x, targets, pctx, position)
+
+        if (pctx is not None
+                and getattr(pctx, "gather_prefetch", 0) > 1
+                and pctx.is_multi_device and not pctx.pipe_parallel):
+            # ZeRO-3 layer-ahead weight-gather prefetch: explicit double-
+            # buffered gathers replace the GSPMD gather-on-demand scan,
+            # on the forward and (via the scan's custom_vjp) the remat
+            # backward.  The engine only sets pctx.gather_prefetch when
+            # the stage/mesh/model contract holds.
+            from ..parallel.comm import GatherPrefetchScan
+            pscan = GatherPrefetchScan(
+                pctx.gather_prefetch, pctx.mesh, pctx.stacked_specs,
+                pctx.stacked_shard_specs,
+                groups=pctx.gather_groups, data_axis=pctx.data_axis,
+                compute_dtype=self.config.compute_dtype,
+            )
+            x = pscan.scan(block, stacked, x,
+                           unroll=self.config.scan_unroll)
             return self.head(params, x, targets, pctx, position)
 
         if pctx is not None and pctx.pipe_parallel:

@@ -97,6 +97,11 @@ class LlamaModel(GPT2Model):
     """Same functional contract as GPT2Model: init / apply / generate."""
 
     pipeline_capable = True
+    # inherits apply() and with it the bucketed grad-release tap AND the
+    # ZeRO-3 prefetched weight-gather scan — restated so a future apply()
+    # override can't silently claim capabilities it dropped
+    grad_bucket_capable = True
+    gather_prefetch_capable = True
 
     def __init__(self, config: LlamaConfig):
         super().__init__(config)

@@ -105,6 +105,9 @@ class MoEGPT(GPT2Model):
     # accumulator in the carry and does not thread the engine's bucketed
     # grad-release tap; the engine rejects grad_buckets > 1 for it
     grad_bucket_capable = False
+    # ...nor the ZeRO-3 prefetched weight-gather scan (same aux-carry
+    # reason); the engine rejects gather_prefetch >= 2 for it
+    gather_prefetch_capable = False
     # 1F1B (round 3): the aux loss joins as a constant-cotangent second
     # output of the layer slab (pipeline.py with_aux), so MoE runs the
     # O(S)-memory schedule too

@@ -235,6 +235,8 @@ class ZeroEngine:
         grad_comm_groups: Optional[int] = None,
         grad_comm_error_feedback: bool = True,
         grad_buckets: int = 1,
+        gather_prefetch: int = 0,
+        gather_groups: Optional[int] = None,
     ):
         """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
         tokens shard over it and attention runs as a ppermute ring
@@ -337,6 +339,35 @@ class ZeroEngine:
         fire only on the final microbatch, the accumulated prefix rides
         into the taps), grad clip, loss scaling, and telemetry.  Inert
         (warning) on a 1-device data axis.
+
+        gather_prefetch: ZeRO-3 layer-ahead weight-gather prefetch
+        (parallel/comm.GatherPrefetchScan) — the forward/weight-side
+        twin of grad_buckets.  With K >= 2 the block scan issues layer
+        k+(K-1)'s parameter all-gather explicitly while layer k
+        computes, holding at most K layers' gathered weights (K=2 =
+        double buffer), on the forward AND the remat re-forward/backward
+        (a custom_vjp reverse scan that also prefetches, and constrains
+        each layer's dW to the sharded layout so the grad
+        reduce-scatter stays in-loop) — DeepSpeed's stage-3 parameter
+        prefetch, XLA-native (Xu et al. arXiv 2004.13336 is the
+        weight-update-sharding precedent for making collective placement
+        explicit rather than partitioner-implicit).  Composes with
+        gather_quant="fp8" (the prefetched gathers move f8 bytes) and
+        with accum / grad clip / loss scaling / dropout / telemetry.
+        `gather_groups=m` adds the hierarchical 2-hop gather: resting
+        precision (f8 when quantized) within m consecutive ranks,
+        compute dtype across groups — mirroring grad_comm_groups; needs
+        a pure data-parallel mesh (the gather runs a shard_map over the
+        data axis).  ZeRO-3 only (stages 0-2 have no per-layer weight
+        gather), scanned stack only (scan_unroll=1), no pipeline axis,
+        and the model must be gather_prefetch_capable (GPT-2/Llama;
+        MoE's scan carries an aux accumulator).  K in (0, 1) is OFF:
+        the compiled step is byte-identical to an un-knobbed engine
+        (pinned by tests/test_zero3_gather_prefetch.py).  Inert (warning) on
+        a 1-device data axis.  Cost: K-1 extra clamped end-of-scan
+        gathers per pass — (L+K-1)/L of the on-demand gather wire,
+        priced in comm_report; placement measured by
+        utils/hlo_comm.overlap_report (gather_overlap_frac).
 
         offload_opt_state: ZeRO-Offload-style placement — optimizer
         moments REST in host memory (NamedSharding memory_kind
@@ -574,7 +605,10 @@ class ZeroEngine:
                 raise ValueError(
                     "grad_buckets does not compose with gather_quant "
                     "(the f8 stacked leaves' cotangents would reach the "
-                    "bucket collectives in e4m3)"
+                    "bucket collectives in e4m3); for overlapped "
+                    "quantized-weight traffic use ZeRO-3 with "
+                    "gather_prefetch instead — gather_quant='fp8' and "
+                    "gather_prefetch compose"
                 )
             if not self._bucketed_active:
                 warnings.warn(
@@ -583,6 +617,86 @@ class ZeroEngine:
                     "to overlap); running the monolithic path",
                     stacklevel=2,
                 )
+
+        # ZeRO-3 layer-ahead weight-gather prefetch (gather_prefetch=):
+        # the forward/weight-side twin of grad_buckets — settle the gate
+        # here; the pctx gains the knob + sharded slice specs below, once
+        # the layout tables exist
+        self.gather_prefetch = int(gather_prefetch) if gather_prefetch \
+            else 0
+        if self.gather_prefetch < 0:
+            raise ValueError(
+                f"gather_prefetch must be >= 0 (0/1 = the on-demand "
+                f"gather; K >= 2 holds K layers), got {gather_prefetch}"
+            )
+        self.gather_groups = int(gather_groups) if gather_groups else None
+        self._gather_prefetch_active = (
+            self.gather_prefetch > 1 and self.data_parallel
+            and self.n_shard > 1
+        )
+        if self.gather_prefetch > 1:
+            if self.stage != 3:
+                raise ValueError(
+                    "gather_prefetch requires ZeRO-3 (stages 0-2 keep "
+                    "params replicated/gathered once — there is no "
+                    "per-layer weight gather to prefetch)"
+                )
+            if not getattr(model, "gather_prefetch_capable", False):
+                raise ValueError(
+                    f"{type(model).__name__} does not thread the "
+                    "prefetched weight-gather scan through its layer "
+                    "loop (gather_prefetch_capable=False)"
+                )
+            if self.pipe_axis is not None:
+                raise ValueError(
+                    "gather_prefetch does not compose with "
+                    "pipeline_parallel (the pipe axis owns the stacked "
+                    "layer dim the prefetch scan slices)"
+                )
+            if _unroll is True or _unroll not in (1, False):
+                raise ValueError(
+                    "gather_prefetch rides the layer scan; it cannot "
+                    "combine with scan_unroll != 1"
+                )
+            _nl = getattr(getattr(model, "config", None), "n_layer", None)
+            if _nl is not None and self.gather_prefetch > _nl:
+                raise ValueError(
+                    f"gather_prefetch={self.gather_prefetch} holds more "
+                    f"layers than the model has (n_layer={_nl})"
+                )
+            if not self._gather_prefetch_active:
+                warnings.warn(
+                    f"gather_prefetch={self.gather_prefetch} is inert on "
+                    "a 1-device data axis (there is no weight gather to "
+                    "prefetch); running the on-demand path",
+                    stacklevel=2,
+                )
+        if self.gather_groups:
+            if self.gather_prefetch <= 1:
+                # loud rejection, not a silently-flat gather mislabeled
+                # as the 2-hop schedule (the grad_comm_groups convention)
+                raise ValueError(
+                    "gather_groups requires gather_prefetch >= 2 (the "
+                    "2-hop gather lives in the explicit prefetched "
+                    "schedule)"
+                )
+            busy = [ax for ax in (self.seq_axis, self.model_axis,
+                                  self.expert_axis, self.pipe_axis)
+                    if ax is not None]
+            if busy:
+                raise ValueError(
+                    f"gather_groups needs a pure data-parallel mesh (the "
+                    f"2-hop gather runs a shard_map over the data axis); "
+                    f"active axes: {busy}"
+                )
+            if self._gather_prefetch_active:
+                inner = self.gather_groups
+                if inner < 2 or inner >= self.n_shard \
+                        or self.n_shard % inner:
+                    raise ValueError(
+                        f"gather_groups={inner} must be a proper divisor "
+                        f"of the data-axis size {self.n_shard} (>= 2)"
+                    )
 
         shapes = model.param_shapes()
         # API-parity ownership table (the reference's cache rank map).
@@ -682,6 +796,25 @@ class ZeroEngine:
         self.pctx = dataclasses.replace(
             self.pctx, stacked_specs=stacked_specs
         )
+        if self._gather_prefetch_active:
+            # the prefetched scan needs BOTH per-layer layouts: gathered
+            # (stacked_specs above — the gather target) and resting-
+            # sharded (the gather source + the per-layer dW cotangent
+            # constraint that keeps the reduce-scatter in-loop)
+            stacked_shard = {}
+            for name, s in shapes.items():
+                if not name.startswith("h."):
+                    continue
+                entries = list(specs[name]) + [None] * (
+                    len(s.shape) - len(specs[name])
+                )
+                stacked_shard[name[len("h."):]] = P(*entries[1:])
+            self.pctx = dataclasses.replace(
+                self.pctx,
+                gather_prefetch=self.gather_prefetch,
+                gather_groups=self.gather_groups,
+                stacked_shard_specs=stacked_shard,
+            )
         # where params LIVE between steps
         self._param_spec_rest = specs if self.stage >= 3 else base
         self._param_shardings = _to_shardings(self._param_spec_rest, mesh)
@@ -692,7 +825,16 @@ class ZeroEngine:
         )
         self._opt_shardings = _to_shardings(opt_specs, mesh)
         self.offload_opt_state = bool(offload_opt_state)
-        self.offload_prefetch = max(2, int(offload_prefetch))
+        # validated, not silently clamped (the old max(2, ...) floor ate
+        # user intent): 1 is honored as "no double buffer" — each leaf's
+        # inbound transfer chains on the PREVIOUS leaf's outbound, fully
+        # serial streaming at minimum in-flight moment memory
+        self.offload_prefetch = int(offload_prefetch)
+        if self.offload_prefetch < 1:
+            raise ValueError(
+                f"offload_prefetch must be >= 1 (1 = serial streaming, "
+                f"no double buffer; default 2), got {offload_prefetch}"
+            )
         if self.offload_opt_state:
             from ..optim.base import Optimizer as _OptBase
             if type(optimizer).update is not _OptBase.update:
@@ -1605,6 +1747,10 @@ class ZeroEngine:
                 extras += "(no-ef)"
         if self._bucketed_active:
             extras += f", grad_buckets={self.grad_buckets}"
+        if self._gather_prefetch_active:
+            extras += f", gather_prefetch={self.gather_prefetch}"
+            if self.gather_groups:
+                extras += f"(2-hop inner={self.gather_groups})"
         return (
             f"{name}(stage={self.stage}, devices={self.n_dev}, "
             f"accum={self.accum_steps}, params sharded="

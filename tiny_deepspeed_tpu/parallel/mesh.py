@@ -175,6 +175,21 @@ class ParallelContext:
     # partitioner fuses the dequant multiply shard-side and gathers full
     # precision).  None outside an engine.
     stacked_specs: _Optional[dict] = None
+    # ZeRO-3 layer-ahead weight-gather prefetch (engine gather_prefetch=,
+    # parallel/comm.GatherPrefetchScan): >= 2 switches the model's layer
+    # scan to the explicit prefetched gather holding at most this many
+    # layers' gathered weights (2 = double buffer).  0/1 = the plain
+    # GSPMD gather-on-demand scan (byte-identical program).
+    gather_prefetch: int = 0
+    # hierarchical 2-hop gather: that many consecutive ranks per
+    # resting-precision intra-group hop, compute dtype across groups
+    # (mirrors grad_comm_groups; needs gather_prefetch >= 2, pure DP)
+    gather_groups: _Optional[int] = None
+    # {stacked leaf name: in-scan SHARDED PartitionSpec} — each per-layer
+    # block weight's resting ZeRO layout after the leading layer axis is
+    # sliced off; the prefetched scan's source layout for gathers and the
+    # target layout for per-layer dW cotangents (in-loop reduce-scatter)
+    stacked_shard_specs: _Optional[dict] = None
 
     @property
     def is_multi_device(self) -> bool:

@@ -316,6 +316,20 @@ class Telemetry:
         self.gauge(
             "grad_comm_overlap_frac", overlap["grad_comm_overlap_frac"]
         )
+        # the gathering side (ZeRO-3 / gather_prefetch): loop-resident
+        # all-gather wire — the measured placement of the per-layer
+        # weight gathers (a hoist regression reads 0; ring/pipe
+        # collective-permutes are deliberately excluded, hlo_comm.py)
+        self.gauge(
+            "gather_overlap_frac", overlap["gather_overlap_frac"]
+        )
+        out["gather_overlap"] = {
+            k: overlap[k] for k in (
+                "gather_wire_bytes_in_loops", "gather_wire_bytes_total",
+                "gather_overlap_frac", "gather_async_windows",
+                "gather_async_windows_overlapped",
+            )
+        }
         modeled = float(model_rep.get("total_bytes_per_step", 0.0))
         if modeled > 0:
             out["comm_delta"] = round(

@@ -70,6 +70,11 @@ META_FIELDS: Dict[str, tuple] = {
     # resident vs top-level reducing-collective wire + async start->done
     # windows — the measured side of the grad_buckets knob
     "comm_overlap": dict,
+    # the gathering-collective half of the same analysis (all-gather
+    # loop residency + gather-only async windows; ring/pipe permutes
+    # excluded — hlo_comm._GATHER_OPS) — the measured side of the
+    # ZeRO-3 gather_prefetch knob
+    "gather_overlap": dict,
     # quantized grad-collective model (parallel/comm.modeled_wire_bytes):
     # mode, elems_padded, quant vs fp32-all-reduce wire bytes
     "grad_comm": dict,

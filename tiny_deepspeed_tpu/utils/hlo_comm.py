@@ -455,11 +455,24 @@ def async_windows(compiled_text: str) -> List[Dict[str, object]]:
     return out
 
 
+# the GATHERING classification is all-gather ONLY, deliberately: every
+# weight-gather schedule in this codebase (the GSPMD on-demand path, the
+# prefetched constraint, and both hops of the hierarchical 2-hop gather)
+# lowers to all-gather, while collective-permute in these programs always
+# carries ring-attention K/V rotation or pipeline microbatch hops —
+# activation traffic whose loop residency would both inflate
+# gather_overlap_frac on engines with no weight gathers at all and MASK a
+# real gather-hoist regression under zero3 x seq-parallel (the ppermute
+# bytes would keep the frac high after every all-gather left the loop)
+_GATHER_OPS = ("all-gather",)
+
+
 def overlap_report(compiled_text: str,
                    led: Optional[Dict[str, object]] = None
                    ) -> Dict[str, object]:
     """Overlap-window analysis of a compiled step's collectives: how much
-    of the gradient wire is issued where the scheduler can hide it.
+    of the gradient AND weight-gather wire is issued where the scheduler
+    can hide it.
 
     Two complementary signals:
 
@@ -478,6 +491,18 @@ def overlap_report(compiled_text: str,
         TPU/GPU HLO, how many compute ops are actually in flight between
         a collective's issue and its completion.
 
+    The GATHERING side (ISSUE 4, `gather_prefetch`) is classified
+    symmetrically: `gather_overlap_frac` = loop-resident wire / total
+    wire over the all-gathers (the op every weight-gather schedule here
+    lowers to, 2-hop hierarchical gathers included; collective-permute
+    is deliberately excluded — see _GATHER_OPS), plus the gather-only
+    async window counts.  Under ZeRO-3 the per-layer gathers
+    are loop-resident whether on-demand or prefetched — the frac catches
+    hoist regressions (a gather pulled out of the scan = full-model HBM);
+    WHERE in the body the gather issues (ahead of the consuming layer's
+    compute or serialized in front of it) is the async-window half, a
+    post-scheduling TPU/GPU signal.
+
     `led` reuses an already-built `collective_ledger` of the same text
     (the regex computation-graph walk over a multi-MB module is the
     expensive part; telemetry's capture_compiled passes its own).
@@ -488,11 +513,20 @@ def overlap_report(compiled_text: str,
         led["wire_bytes_in_loops"].get(op, 0.0) for op in _REDUCE_OPS
     )
     total_w = sum(led["wire_bytes"].get(op, 0.0) for op in _REDUCE_OPS)
+    g_loop = sum(
+        led["wire_bytes_in_loops"].get(op, 0.0) for op in _GATHER_OPS
+    )
+    g_total = sum(led["wire_bytes"].get(op, 0.0) for op in _GATHER_OPS)
     windows = async_windows(compiled_text)
+    g_windows = [w for w in windows if w["op"] in _GATHER_OPS]
     return {
         "reduce_wire_bytes_in_loops": float(loop_w),
         "reduce_wire_bytes_total": float(total_w),
         "grad_comm_overlap_frac": float(loop_w / total_w) if total_w
+        else 0.0,
+        "gather_wire_bytes_in_loops": float(g_loop),
+        "gather_wire_bytes_total": float(g_total),
+        "gather_overlap_frac": float(g_loop / g_total) if g_total
         else 0.0,
         "loop_collective_counts": {
             k: float(v) for k, v in led["count_in_loops"].items()
@@ -503,6 +537,10 @@ def overlap_report(compiled_text: str,
         ),
         "async_window_max_distance": max(
             (w["distance"] for w in windows), default=0
+        ),
+        "gather_async_windows": len(g_windows),
+        "gather_async_windows_overlapped": sum(
+            1 for w in g_windows if w["compute_in_flight"] > 0
         ),
     }
 

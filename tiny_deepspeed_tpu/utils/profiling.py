@@ -234,8 +234,12 @@ def comm_report(engine) -> Dict[str, float]:
     cd_itemsize = (
         jnp.dtype(cfg.compute_dtype).itemsize if cfg is not None else 4
     )
-    block_cd = nonblock_cd = 0
+    block_cd = nonblock_cd = block_deq = 0
     if stage == 3:
+        block_deq = sum(
+            int(np.prod(s.shape)) * cd_itemsize
+            for name, s in shapes.items() if name.startswith("h.")
+        )
         try:
             # what the per-layer gathers ACTUALLY move: the stacked compute
             # tree's own dtypes (compute dtype normally; f8 + f32 scales
@@ -313,11 +317,33 @@ def comm_report(engine) -> Dict[str, float]:
                 quant_wire_bytes=k * qb["quant_wire_bytes"]
                 + qt["quant_wire_bytes"],
             )
+    # gather_prefetch (parallel/comm.GatherPrefetchScan): the explicit
+    # prefetched schedule issues K-1 extra clamped end-of-scan gathers
+    # per pass (fwd + remat bwd each run L+K-1 layer gathers), and
+    # gather_groups reroutes each layer's gather through the 2-hop
+    # shard_map (resting precision intra-group, compute dtype inter) —
+    # priced by comm.modeled_gather_wire_bytes, the same accounting site
+    # telemetry reads
+    gp = int(getattr(engine, "gather_prefetch", 0) or 0)
+    gg = getattr(engine, "gather_groups", None)
+    gp_active = bool(getattr(engine, "_gather_prefetch_active", False))
+    z3_gather = (2 * block_cd + nonblock_cd) * ring if stage == 3 else 0.0
+    if stage == 3 and gp_active:
+        from ..parallel.comm import modeled_gather_wire_bytes
+        nl = int(getattr(cfg, "n_layer", 0) or 0)
+        passes = 2.0 * (nl + gp - 1) / nl if nl else 2.0
+        per_pass = modeled_gather_wire_bytes(
+            block_cd, block_deq, n, inner=gg
+        )
+        z3_gather = passes * per_pass + nonblock_cd * ring
+
     report = {
         "devices": n,
         "param_bytes": g,
         "grad_comm": getattr(engine, "grad_comm", "fp32"),
         "grad_buckets": int(getattr(engine, "grad_buckets", 1)),
+        "gather_prefetch": gp,
+        "gather_groups": int(gg) if gg else 0,
         # full schedule model kept alongside the headline number so
         # downstream gauges (telemetry capture_compiled) read ONE
         # accounting site instead of re-deriving it
@@ -332,10 +358,9 @@ def comm_report(engine) -> Dict[str, float]:
         stage >= 2 and not quant,
         "param_all_gather_bytes": g * ring if stage in (1, 2) else 0.0,
         # ZeRO-3: block params gathered per layer in fwd AND in the remat
-        # bwd; non-block params once — all at compute precision
-        "zero3_layer_gather_bytes": (
-            (2 * block_cd + nonblock_cd) * ring if stage == 3 else 0.0
-        ),
+        # bwd; non-block params once — all at compute precision (plus the
+        # prefetch overshoot / 2-hop reroute when gather_prefetch is on)
+        "zero3_layer_gather_bytes": z3_gather,
     }
     report["total_bytes_per_step"] = sum(
         v for k, v in report.items()
