@@ -763,8 +763,10 @@ def _write_bench_telemetry(path, engine, state, batch, compiled_text,
     headline measurement so the per-step sync barriers cannot perturb it.
     The JSONL renders with scripts/report_run.py; the record's
     extra.telemetry_jsonl points here."""
+    from tiny_deepspeed_tpu.telemetry.schema import SCHEMA_VERSION
+    from tiny_deepspeed_tpu.telemetry.trace import collective_span_template
     from tiny_deepspeed_tpu.utils.hlo_comm import (
-        collective_ledger, ledger_summary,
+        collective_ledger, ledger_summary, overlap_report,
     )
     from tiny_deepspeed_tpu.utils.profiling import (
         MetricsLogger, StepTimer, comm_report,
@@ -772,15 +774,25 @@ def _write_bench_telemetry(path, engine, state, batch, compiled_text,
 
     if os.path.exists(path):
         os.remove(path)  # one run per file: the report reads a single run
-    measured = ledger_summary(collective_ledger(compiled_text))
+    led = collective_ledger(compiled_text)
+    measured = ledger_summary(led)
+    overlap = overlap_report(compiled_text, led=led)
     timer = StepTimer()
     timer.watch(engine)
     with MetricsLogger(path, stdout=False) as ml:
         ml.log_meta(
+            schema_version=SCHEMA_VERSION,
             engine=engine.describe(), model=model_name, devices=n_chips,
             n_params=engine.model.num_params(), batch=b, seq_len=t,
             tokens_per_step=b * t, peak_flops_per_chip=peak_flops,
             comm_model=comm_report(engine), comm_measured=measured,
+            comm_overlap=overlap,
+        )
+        # step-trace span template: trace_view.py renders the sidecar's
+        # timeline without recompiling the step
+        ml.log_meta(
+            kind="trace",
+            spans=collective_span_template(measured),
         )
         for i in range(steps):
             with timer.step() as tm:

@@ -247,20 +247,34 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "tokens/s) as JSONL (utils/profiling.MetricsLogger)",
     )
     p.add_argument(
-        "--telemetry", action="store_true",
+        "--telemetry", nargs="?", const="on", default=None,
+        choices=("on", "layers"),
         help="full run telemetry (tiny_deepspeed_tpu/telemetry/): "
              "on-device health metrics computed inside the compiled step "
              "(grad/update/param norms, non-finite counts), step-time "
              "breakdown (data wait / host->device / compute) with "
-             "recompile detection, HBM watermarks, and measured HLO-"
-             "ledger collective bytes in the run_meta record.  Pairs "
-             "with --metrics; render with scripts/report_run.py",
+             "recompile detection, HBM watermarks, measured HLO-ledger "
+             "collective bytes + step-trace span template in the meta "
+             "records, a flight recorder flushed on anomalies, and "
+             "straggler gauges.  '--telemetry layers' additionally "
+             "computes PER-LAYER health inside the block scan "
+             "(grad/activation norms + non-finite counts; the first-NaN "
+             "layer localized in one step — plain-scan engines, "
+             "GPT-2/Llama).  Pairs with --metrics; render with "
+             "scripts/report_run.py and scripts/trace_view.py",
     )
     p.add_argument(
         "--telemetry-trace", default=None, metavar="DIR",
         help="with --telemetry: capture ONE jax.profiler trace into DIR "
              "the first time a step exceeds 2.5x the rolling median step "
              "time (anomaly capture; off without a directory)",
+    )
+    p.add_argument(
+        "--flight-steps", type=int, default=64, metavar="N",
+        help="with --telemetry: flight-recorder ring size — the last N "
+             "steps' health (+ per-layer health under 'layers') flushed "
+             "as one JSONL 'flight' record when the anomaly detector "
+             "fires on a slow step or non-finite health (0 disables)",
     )
     p.add_argument(
         "--save-every", type=int, default=0, metavar="N",
@@ -365,10 +379,12 @@ def run(engine_cls, args, single_device=False):
         ),
     )
     telem = None
-    if getattr(args, "telemetry", False):
+    if getattr(args, "telemetry", None):
         from tiny_deepspeed_tpu.telemetry import Telemetry
         telem = Telemetry(
-            trace_dir=getattr(args, "telemetry_trace", None)
+            trace_dir=getattr(args, "telemetry_trace", None),
+            layers=getattr(args, "telemetry", None) == "layers",
+            flight_steps=getattr(args, "flight_steps", 64),
         )
     train_kw = dict(
         grad_clip=getattr(args, "grad_clip", 0.0) or None,
@@ -494,6 +510,11 @@ def run(engine_cls, args, single_device=False):
     trace_started = False
     t0 = time.perf_counter()
     ran = 0
+    # per-host straggler signal: data-load + staging wall, pure host code
+    # — collectives couple the DEVICE timelines across hosts (whole-step
+    # wall converges to the slowest host on every host), so only an
+    # uncoupled host-side measure can attribute a straggler
+    host_prep_s = 0.0
     for it in range(start_iter, args.iters):
         it_t0 = time.perf_counter()
         if profile_dir is not None and it == start_iter + 2:
@@ -508,11 +529,12 @@ def run(engine_cls, args, single_device=False):
             # overlap the plain path preserves (their engine.step still
             # pushes the aux un-synced; the compiled program is identical
             # on every rank)
-            with telem.step() as t:
+            with telem.step(index=it) as t:
                 idx, tgt = loader.next()
                 t.mark("data")
                 batch = (jnp.asarray(idx), jnp.asarray(tgt))
                 t.mark("h2d")
+                host_prep_s += time.perf_counter() - it_t0
                 state, loss = engine.step(state, batch)
             ran += 1
             health = telem.last_health
@@ -526,11 +548,19 @@ def run(engine_cls, args, single_device=False):
                     tokens_per_s=b * args.seq_len / max(it_dt, 1e-9),
                     **telem.step_record(),
                 )
+                # anomaly-armed flight flush (slow step or non-finite
+                # health): the last N steps' history lands as ONE
+                # 'flight' record; syncs any per-layer matrices, so it
+                # stays here at logging cadence, off the step hot path
+                reason = telem.maybe_flush_flight(metrics)
+                if reason is not None:
+                    print(f"iter {it:3d} flight record flushed "
+                          f"(reason: {reason})")
         else:
             idx, tgt = loader.next()
-            state, loss = engine.step(
-                state, (jnp.asarray(idx), jnp.asarray(tgt))
-            )
+            batch = (jnp.asarray(idx), jnp.asarray(tgt))
+            host_prep_s += time.perf_counter() - it_t0
+            state, loss = engine.step(state, batch)
             ran += 1
             if rank0:
                 # device->host sync (axon-safe barrier) only where the
@@ -589,6 +619,27 @@ def run(engine_cls, args, single_device=False):
                 n_params=model.num_params(), batch=b,
                 seq_len=args.seq_len, tokens_per_step=b * args.seq_len,
             ))
+            spans = telem.trace_spans()
+            if spans:
+                # step-trace span template (telemetry/trace.py): the
+                # compiled step's collectives by (op, loop residency)
+                # with exact ledger wire bytes — scripts/trace_view.py
+                # joins it with the per-step wall segments above
+                metrics.log_meta(kind="trace", spans=spans)
+        if ran:
+            # per-host straggler attribution over the UNCOUPLED host-side
+            # prep wall (data load + staging): collectives equalize the
+            # device timelines across hosts, so whole-step wall cannot
+            # name a straggler — host-side wait can.  Every rank must
+            # reach this call (process_allgather is a collective);
+            # log_meta itself is rank-0-gated.  Degenerate but
+            # schema-complete on one host.
+            metrics.log_meta(
+                kind="straggler",
+                **telem.sample_stragglers(
+                    step_s=host_prep_s / ran, quantity="host_prep_s",
+                ),
+            )
         telem.flush(metrics)  # registry snapshot -> telemetry_summary record
     if metrics is not None:
         metrics.close()
@@ -600,9 +651,13 @@ def run(engine_cls, args, single_device=False):
         if telem is not None and telem.timer.times:
             tm = telem.timer
             print(f"step time p50 {tm.p50_s * 1e3:.1f}ms "
-                  f"p95 {tm.p95_s * 1e3:.1f}ms; "
+                  f"p95 {tm.p95_s * 1e3:.1f}ms "
+                  f"p99 {tm.p99_s * 1e3:.1f}ms "
+                  f"max {tm.max_s * 1e3:.1f}ms; "
                   f"compiles {tm.compile_count}")
             if getattr(args, "metrics", None):
                 print("run report: python scripts/report_run.py "
+                      f"{args.metrics}")
+                print("step timeline: python scripts/trace_view.py "
                       f"{args.metrics}")
     return state

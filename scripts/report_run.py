@@ -24,34 +24,19 @@ traces).
 from __future__ import annotations
 
 import argparse
-import json
 import math
 import os
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tiny_deepspeed_tpu.telemetry import schema  # noqa: E402
+# ONE loader for both views of a metrics file: trace_view.py reads the
+# same records through the same function, so the two tools can never
+# disagree on record classification
+from tiny_deepspeed_tpu.telemetry.trace import load_run  # noqa: E402
 from tiny_deepspeed_tpu.utils.profiling import _quantile  # noqa: E402
-
-
-def load_run(path: str) -> Tuple[List[dict], List[dict], List[str]]:
-    """(meta records, step records, parse errors) from a metrics JSONL."""
-    metas, steps, errs = [], [], []
-    with open(path) as f:
-        for i, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError as e:
-                errs.append(f"line {i}: invalid JSON ({e})")
-                continue
-            (metas if isinstance(rec, dict) and "kind" in rec
-             else steps).append(rec)
-    return metas, steps, errs
 
 
 def _fmt_bytes(n: float) -> str:
@@ -112,7 +97,9 @@ def render_report(metas: List[dict], steps: List[dict],
         out.append(
             f"- step time: mean {sum(warm) / len(warm) * 1e3:.1f} ms, "
             f"p50 {_quantile(warm, 0.5) * 1e3:.1f} ms, "
-            f"p95 {_quantile(warm, 0.95) * 1e3:.1f} ms"
+            f"p95 {_quantile(warm, 0.95) * 1e3:.1f} ms, "
+            f"p99 {_quantile(warm, 0.99) * 1e3:.1f} ms, "
+            f"max {max(warm) * 1e3:.1f} ms"
         )
     if toks:
         warm_toks = toks[1:] if len(toks) > 1 else toks
@@ -256,6 +243,15 @@ def render_report(metas: List[dict], steps: List[dict],
     traces = [r["anomaly_trace"] for r in steps if r.get("anomaly_trace")]
     if traces:
         flags.append(f"anomaly trace captured: `{traces[0]}`")
+    flight = _meta(metas, "flight")
+    if flight is not None:
+        fl = (f"flight record flushed (reason: "
+              f"{flight.get('reason', '?')}, "
+              f"{len(flight.get('steps') or [])} step(s) of history)")
+        fnl = flight.get("first_nonfinite_layer")
+        if fnl is not None:
+            fl += f"; non-finiteness ORIGINATED at layer {fnl}"
+        flags.append(fl)
     if warm:
         p50 = _quantile(warm, 0.5)
         slow = [t for t in warm if p50 and t > 2 * p50]
@@ -271,6 +267,26 @@ def render_report(metas: List[dict], steps: List[dict],
         out.append("- no flags raised")
     out.append("")
 
+    # -- multi-host stragglers ---------------------------------------------
+    strag = _meta(metas, "straggler")
+    if strag is not None and strag.get("hosts", 1) > 1:
+        qty = strag.get("quantity", "step_s")
+        out.append(f"## Stragglers (per-host {qty})\n")
+        by_host = strag.get("step_s_by_host") or []
+        out.append(f"- hosts: {strag['hosts']}")
+        out.append(
+            f"- slowest host: {strag.get('slowest_host')} "
+            f"({max(by_host) * 1e3:.1f} ms vs median "
+            f"{_quantile(sorted(by_host), 0.5) * 1e3:.1f} ms)"
+        )
+        frac = strag.get("straggler_frac", 0.0)
+        out.append(
+            f"- straggler_frac: {frac:.3f} — the fraction of the slowest "
+            "host's time the median host would not have spent (every "
+            "SPMD step runs at the slowest host's pace)"
+        )
+        out.append("")
+
     # -- telemetry registry summary ----------------------------------------
     if summary:
         out.append("## Telemetry registry\n")
@@ -281,15 +297,24 @@ def render_report(metas: List[dict], steps: List[dict],
             ) + "\n")
         hists = summary.get("histograms") or {}
         if hists:
-            out.append("| histogram | count | mean | p50 | p95 | max |")
-            out.append("|---|---|---|---|---|---|")
+            out.append(
+                "| histogram | count | mean | p50 | p95 | p99 | max |"
+            )
+            out.append("|---|---|---|---|---|---|---|")
             for k, h in sorted(hists.items()):
                 out.append(
                     f"| {k} | {h.get('count', 0)} | {h.get('mean', 0):.4g} "
                     f"| {h.get('p50', 0):.4g} | {h.get('p95', 0):.4g} "
+                    f"| {h.get('p99', h.get('p95', 0)):.4g} "
                     f"| {h.get('max', 0):.4g} |"
                 )
             out.append("")
+    if _meta(metas, "trace") is not None:
+        out.append(
+            "Step timeline: `python scripts/trace_view.py "
+            f"{source or 'RUN.jsonl'}` -> Chrome-trace JSON "
+            "(chrome://tracing / Perfetto).\n"
+        )
     return "\n".join(out) + "\n"
 
 
@@ -305,6 +330,14 @@ def check(path: str) -> int:
             file=sys.stderr,
         )
         return 1
+    if counts["step"] + counts["meta"] == 0:
+        print(f"{path}: no records (empty metrics file)", file=sys.stderr)
+        return 2
+    metas, _, _ = load_run(path)
+    warn = schema.version_warning(metas)
+    if warn:
+        # advisory only: field validation above is the hard gate
+        print(f"{path}: warning: {warn}", file=sys.stderr)
     print(
         f"{path}: ok — {counts['step']} step record(s), "
         f"{counts['meta']} meta record(s)"
@@ -328,7 +361,15 @@ def main(argv=None) -> int:
         return check(args.jsonl)
     metas, steps, errs = load_run(args.jsonl)
     for e in errs:
-        print(f"warning: {e}", file=sys.stderr)
+        # a truncated final line (crashed writer) is the common case:
+        # say so clearly, render what parsed, and exit non-zero below
+        print(f"warning: {args.jsonl}: {e}", file=sys.stderr)
+    if not metas and not steps:
+        print(
+            f"{args.jsonl}: no records (empty or fully truncated metrics "
+            "file — nothing to report)", file=sys.stderr,
+        )
+        return 2
     report = render_report(metas, steps, source=args.jsonl)
     if args.out:
         with open(args.out, "w") as f:
@@ -336,6 +377,12 @@ def main(argv=None) -> int:
         print(f"wrote {args.out}")
     else:
         print(report)
+    if errs:
+        print(
+            f"{args.jsonl}: {len(errs)} unparseable line(s) — the report "
+            "above covers only the valid records", file=sys.stderr,
+        )
+        return 1
     return 0
 
 

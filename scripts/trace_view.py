@@ -1,0 +1,84 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Export a training run's metrics JSONL as a Chrome-trace timeline.
+
+    python scripts/trace_view.py RUN.jsonl [-o TRACE.json]
+
+Load TRACE.json in chrome://tracing or https://ui.perfetto.dev.  The
+timeline shows, per step: the whole-step span, the measured host wall
+segments (data wait / host->device / device compute+sync — StepTimer
+`mark()`), and the compiled step's collective spans from the HLO ledger
+(`utils/hlo_comm.py`) instantiated inside the compute window — widths
+proportional to wire bytes (schematic), annotations exact: wire bytes,
+op count, per-dtype split, loop-resident flag.  Span assembly lives in
+`tiny_deepspeed_tpu/telemetry/trace.py`; the input comes from
+`examples/* --telemetry --metrics RUN.jsonl` (which also writes the
+`trace` span-template record) or `bench.py`'s telemetry sidecar.
+
+Exit codes: 0 ok; 1 parse errors in the JSONL; 2 missing/empty input or
+no timed step records to lay out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_module():
+    """telemetry/trace.py loaded by file path: the module is pure-python
+    (json + typing), but importing it through the package would pull the
+    whole jax stack in — a multi-second tax on a viewer that only
+    reshuffles JSONL."""
+    spec = importlib.util.spec_from_file_location(
+        "tiny_deepspeed_tpu_trace_standalone",
+        os.path.join(_REPO, "tiny_deepspeed_tpu", "telemetry", "trace.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace = _load_trace_module()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="metrics JSONL from a training run")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the Chrome-trace JSON here "
+                         "(default: <input>.trace.json)")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.jsonl):
+        print(f"{args.jsonl}: no such file", file=sys.stderr)
+        return 2
+    metas, steps, errs = trace.load_run(args.jsonl)
+    for e in errs:
+        print(f"warning: {args.jsonl}: {e}", file=sys.stderr)
+    if not metas and not steps:
+        print(f"{args.jsonl}: no records (empty or fully truncated "
+              "metrics file)", file=sys.stderr)
+        return 2
+    doc = trace.chrome_trace(metas, steps, source=args.jsonl)
+    n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    if not n_spans:
+        print(f"{args.jsonl}: no timed step records (run with "
+              "--telemetry --metrics to record step_s + wall segments)",
+              file=sys.stderr)
+        return 2
+    out = args.out or (os.path.splitext(args.jsonl)[0] + ".trace.json")
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    print(f"wrote {out}: {n_spans} spans over {len(steps)} step(s) — "
+          "open in chrome://tracing or https://ui.perfetto.dev")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

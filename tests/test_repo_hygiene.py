@@ -54,3 +54,39 @@ def test_gitignore_covers_bytecode():
         lines = {ln.strip() for ln in f}
     assert "__pycache__/" in lines
     assert "*.pyc" in lines
+
+
+def test_gauge_names_documented_in_schema():
+    """Name-drift guard: every telemetry gauge registered by a literal
+    `.gauge("name", ...)` call anywhere in the package/scripts/bench must
+    be documented in telemetry/schema.GAUGES — dashboards key on these
+    names, so an undocumented (or renamed-in-code-only) gauge silently
+    desynchronizes them from the code."""
+    import re
+
+    from tiny_deepspeed_tpu.telemetry import schema
+
+    pat = re.compile(r"""\.gauge\(\s*['"]([A-Za-z0-9_]+)['"]""")
+    used = {}
+    roots = [
+        os.path.join(REPO, "tiny_deepspeed_tpu"),
+        os.path.join(REPO, "scripts"),
+        os.path.join(REPO, "examples"),
+        os.path.join(REPO, "bench.py"),
+    ]
+    for root in roots:
+        files = [root] if root.endswith(".py") else [
+            os.path.join(dp, f)
+            for dp, _, fs in os.walk(root) for f in fs if f.endswith(".py")
+        ]
+        for path in files:
+            with open(path) as f:
+                for name in pat.findall(f.read()):
+                    used.setdefault(name, os.path.relpath(path, REPO))
+    assert used, "no gauge call sites found — the grep pattern rotted"
+    undocumented = {n: p for n, p in used.items() if n not in schema.GAUGES}
+    assert not undocumented, (
+        f"gauge names registered in code but not documented in "
+        f"telemetry/schema.GAUGES: {undocumented} — add them there "
+        "(one line each) so the metrics surface stays self-describing"
+    )

@@ -430,7 +430,8 @@ class TestExampleEndToEnd:
         assert "telemetry=on" in r.stdout
         counts, errs = schema.validate_file(jsonl)
         assert errs == []
-        assert counts["step"] == 4 and counts["meta"] == 2
+        # run_meta + trace (span template) + straggler + telemetry_summary
+        assert counts["step"] == 4 and counts["meta"] == 4
         rr = _load_report_run()
         metas, steps, _ = rr.load_run(jsonl)
         report = rr.render_report(metas, steps, source=jsonl)
@@ -442,6 +443,31 @@ class TestExampleEndToEnd:
         meta = [m for m in metas if m.get("kind") == "run_meta"][0]
         assert meta["comm_measured"]["total_wire_bytes"] > 0
         assert meta["comm_model"]["grad_allreduce_bytes"] > 0
+        assert meta["schema_version"] == schema.SCHEMA_VERSION
+        # acceptance (ISSUE 5): trace_view.py emits valid Chrome-trace
+        # JSON for this CPU-mesh ddp run, and every loop-resident
+        # collective span carries wire bytes matching the hlo_comm ledger
+        trace_json = str(tmp_path / "ddp_run.trace.json")
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "trace_view.py"),
+             jsonl, "-o", trace_json],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        doc = json.load(open(trace_json))
+        assert doc["traceEvents"]
+        ledger_loops = meta["comm_measured"]["wire_bytes_in_loops"]
+        loop_spans = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "X"
+            and e.get("args", {}).get("loop_resident")
+        ]
+        assert loop_spans
+        for e in loop_spans:
+            assert e["args"]["wire_bytes"] == pytest.approx(
+                ledger_loops[e["args"]["op"]], rel=1e-6,
+            )
 
 
 class TestBenchTelemetrySidecar:
@@ -488,7 +514,8 @@ class TestBenchTelemetrySidecar:
         )
         counts, errs = schema.validate_file(path)
         assert errs == []
-        assert counts["step"] == 2 and counts["meta"] == 1
+        # run_meta + the trace span-template record
+        assert counts["step"] == 2 and counts["meta"] == 2
         rr = _load_report_run()
         metas, steps, _ = rr.load_run(path)
         report = rr.render_report(metas, steps, source=path)

@@ -218,6 +218,11 @@ class GPT2Model:
     # subclasses that override apply() without that branch must reset
     # this (MoEGPT does — same aux-accumulator scan reason)
     gather_prefetch_capable = True
+    # apply() threads the per-layer health probe
+    # (parallel/comm.layer_health_tap, engine telemetry layers mode)
+    # through the stacked scan tree; subclasses overriding apply()
+    # without the health_probe branch must reset this (MoEGPT does)
+    layer_health_capable = True
 
     def __init__(self, config: GPTConfig):
         self.config = config
@@ -633,9 +638,16 @@ class GPT2Model:
         return dict(stacked, dropout_rng=keys[1:]), x
 
     def block_fn(self, pctx=None):
-        """(x, block_params) -> x, with the configured remat policy applied."""
+        """(x, block_params) -> x, with the configured remat policy applied.
+        A "health_probe" row in bp (engine telemetry layers mode) taps the
+        block output through the per-layer health probe — here rather than
+        in _block so LlamaModel's _block override inherits it."""
         def block(x, bp):
-            return self._block(x, bp, pctx)
+            y = self._block(x, bp, pctx)
+            if "health_probe" in bp:
+                from ..parallel.comm import layer_health_tap
+                y = layer_health_tap(y, bp["health_probe"])
+            return y
 
         if self.config.remat:
             block = jax.checkpoint(block, policy=self.remat_policy())
@@ -693,7 +705,8 @@ class GPT2Model:
         return logits.astype(jnp.float32)
 
     def apply(self, params, idx, targets: Optional[jax.Array] = None,
-              pctx=None, position=None, rng=None, grad_tap=None):
+              pctx=None, position=None, rng=None, grad_tap=None,
+              health_probe=None):
         """Forward pass.  Returns mean loss if targets given, else logits —
         same contract as reference GPT2Model.forward (model.py:139-157).
 
@@ -710,10 +723,29 @@ class GPT2Model:
         in K groups and each group's stacked-param slice passes through
         the tap's identity custom_vjp, so the backward scan body emits
         that bucket's gradient collective as soon as its grads are final.
-        None (default) keeps the exact single-scan program."""
+        None (default) keeps the exact single-scan program.
+
+        `health_probe` (engine telemetry layers mode) is a zeros
+        (n_layer, 4) f32 array the caller differentiates against: each
+        row rides the stacked scan tree like the per-layer dropout keys
+        and the block output passes through
+        parallel/comm.layer_health_tap, whose cotangent returns per-layer
+        activation/activation-gradient health stats.  None (default)
+        keeps the exact untapped program."""
         x = self.embed(params, idx, pctx)
         stacked = self.stacked_compute_params(params)
         stacked, x = self._dropout_setup(stacked, x, rng)
+        if health_probe is not None:
+            if (pctx is not None and pctx.pipe_parallel) or \
+                    grad_tap is not None or (
+                        pctx is not None
+                        and getattr(pctx, "gather_prefetch", 0) > 1):
+                raise ValueError(
+                    "health_probe rides the plain layer scan; it does not "
+                    "compose with the pipeline forward, grad_tap, or the "
+                    "prefetched weight-gather scan"
+                )
+            stacked = dict(stacked, health_probe=health_probe)
         block = self.block_fn(pctx)
 
         if grad_tap is not None:

@@ -102,6 +102,7 @@ class LlamaModel(GPT2Model):
     # override can't silently claim capabilities it dropped
     grad_bucket_capable = True
     gather_prefetch_capable = True
+    layer_health_capable = True
 
     def __init__(self, config: LlamaConfig):
         super().__init__(config)

@@ -108,6 +108,9 @@ class MoEGPT(GPT2Model):
     # ...nor the ZeRO-3 prefetched weight-gather scan (same aux-carry
     # reason); the engine rejects gather_prefetch >= 2 for it
     gather_prefetch_capable = False
+    # ...nor the per-layer health probe (apply() takes no health_probe);
+    # the engine rejects telemetry layers mode for it
+    layer_health_capable = False
     # 1F1B (round 3): the aux loss joins as a constant-cotangent second
     # output of the layer slab (pipeline.py with_aux), so MoE runs the
     # O(S)-memory schedule too

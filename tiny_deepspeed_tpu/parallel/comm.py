@@ -450,6 +450,56 @@ class GradBucketTap:
 
 
 # ---------------------------------------------------------------------------
+# per-layer health probe (engine telemetry layers mode, ISSUE 5)
+# ---------------------------------------------------------------------------
+
+def _act_stats(x) -> jax.Array:
+    """(2,) f32: [sum of squares, non-finite element count] of one layer's
+    output activation.  Sums run over the LOGICAL array, so under sharded
+    activations XLA inserts the cross-shard psum and every rank reports
+    the same global numbers (the health_vector convention)."""
+    xf = x.astype(jnp.float32)
+    return jnp.stack([
+        jnp.sum(jnp.square(xf)),
+        jnp.sum((~jnp.isfinite(xf)).astype(jnp.float32)),
+    ])
+
+
+@jax.custom_vjp
+def layer_health_tap(x, probe):
+    """Identity on `x`; the (4,) f32 `probe`'s COTANGENT smuggles this
+    layer's health stats out of the step — [act sq-sum, act non-finite
+    count, d(act) sq-sum, d(act) non-finite count].
+
+    The GradBucketTap trick pointed at observability instead of
+    collectives: the engine differentiates the loss w.r.t. a zeros
+    (n_layer, 4) probe that rides the stacked scan tree (one (4,) row per
+    layer, like the per-layer dropout keys), each layer's block output
+    passes through this tap, and the "gradient" of the probe comes back
+    as the per-layer activation/activation-gradient stats — computed
+    INSIDE the compiled step, per layer, with no scan restructuring and
+    no extra host transfers.  The first-NaN layer is read off the stats
+    in one step instead of by bisection.  Forward stats are recomputed
+    bit-exactly by the remat backward (they live inside the block's
+    jax.checkpoint), so the fwd residual costs 2 floats per layer."""
+    return x
+
+
+def _lht_fwd(x, probe):
+    return x, _act_stats(x)
+
+
+def _lht_bwd(stats, g):
+    return g, jnp.concatenate([stats, _act_stats(g)])
+
+
+layer_health_tap.defvjp(_lht_fwd, _lht_bwd)
+
+# probe row width: [act_sq, act_nonfinite, dact_sq, dact_nonfinite]
+LAYER_PROBE_WIDTH = 4
+
+
+# ---------------------------------------------------------------------------
 # ZeRO-3 layer-ahead weight-gather prefetch (engine gather_prefetch=, ISSUE 4)
 # ---------------------------------------------------------------------------
 

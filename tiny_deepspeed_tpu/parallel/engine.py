@@ -282,6 +282,17 @@ class ZeroEngine:
         single device->host transfer as reading the loss.  With
         telemetry=None (the default) the step program is byte-identical
         to an un-knobbed engine (tests/test_telemetry.py pins the HLO).
+        A Telemetry constructed with layers=True additionally turns on
+        per-layer health: the block scan taps every layer's output
+        (parallel/comm.layer_health_tap) and the step also returns an
+        (n_layer, 6) matrix of per-layer activation/activation-gradient/
+        gradient norms and non-finite counts (telemetry/health.
+        LAYER_FIELDS) — the first-NaN layer is localized in one step.
+        Plain-scan engines only (no pipeline/1f1b/grad_buckets/quantized
+        grad_comm/gather_prefetch — rejected loudly) and the model must
+        be layer_health_capable (GPT-2/Llama; MoE is not).  With layers
+        off the program is byte-identical to plain telemetry
+        (tests/test_trace_flight.py pins the HLO).
 
         grad_comm: gradient-collective precision — "fp32" (default: the
         exact GSPMD path, compiled step byte-identical to an un-knobbed
@@ -913,6 +924,51 @@ class ZeroEngine:
         self._telemetry_on = telemetry is not None
         if self._telemetry_on and hasattr(telemetry, "attach"):
             telemetry.attach(self)
+        # per-layer health (Telemetry(layers=True)): the block scan taps
+        # each layer's output through parallel/comm.layer_health_tap and
+        # the step additionally returns an (n_layer, 6) layer-health
+        # matrix (telemetry/health.LAYER_FIELDS) — the first-NaN layer is
+        # localized in ONE step instead of by bisection.  Rides the plain
+        # GSPMD scan only: the explicit-schedule paths (grad_buckets,
+        # quantized grad_comm, gather_prefetch, pipeline, 1f1b) restructure
+        # the scan the probe rides, so they are rejected loudly rather
+        # than silently un-instrumented.  With layers off the compiled
+        # step is byte-identical to plain telemetry
+        # (tests/test_trace_flight.py pins the HLO).
+        self._layers_on = bool(
+            self._telemetry_on and getattr(telemetry, "layers", False)
+        )
+        self._layer_count = int(
+            getattr(getattr(model, "config", None), "n_layer", 0) or 0
+        )
+        if self._layers_on:
+            if not getattr(model, "layer_health_capable", False):
+                raise ValueError(
+                    f"{type(model).__name__} does not thread the per-layer "
+                    "health probe through its layer scan "
+                    "(layer_health_capable=False)"
+                )
+            blockers = []
+            if self.pipe_axis is not None:
+                blockers.append("pipeline_parallel")
+            if self._use_1f1b:
+                blockers.append("pipeline_schedule='1f1b'")
+            if self._bucketed_active:
+                blockers.append("grad_buckets")
+            if self._grad_comm_active:
+                blockers.append("grad_comm quantization")
+            if self._gather_prefetch_active:
+                blockers.append("gather_prefetch")
+            if blockers:
+                raise ValueError(
+                    "telemetry layers mode rides the plain layer scan; it "
+                    f"does not compose with: {', '.join(blockers)}"
+                )
+            if not self._layer_count:
+                raise ValueError(
+                    "telemetry layers mode needs a layered model "
+                    "(config.n_layer)"
+                )
 
         if self.data_parallel:
             batch_spec = P("data", self.seq_axis)  # (B, T): tokens shard too
@@ -970,8 +1026,12 @@ class ZeroEngine:
                 NamedSharding(self.mesh, P()),
             ) + (
                 # telemetry: the packed (5,) health vector rides along,
-                # replicated like the loss
+                # replicated like the loss — plus the (n_layer, 6)
+                # layer-health matrix in layers mode
                 (NamedSharding(self.mesh, P()),) if self._telemetry_on
+                else ()
+            ) + (
+                (NamedSharding(self.mesh, P()),) if self._layers_on
                 else ()
             ),
             donate_argnums=(0,),
@@ -1524,25 +1584,47 @@ class ZeroEngine:
             if self._dropout_active else None
         )
 
-        def loss_fn(p, ix, tg, rng=None):
+        # per-layer health probe (telemetry layers mode): a zeros (L, 4)
+        # array differentiated alongside the params — its "gradient" is
+        # the per-layer activation/activation-gradient stats smuggled out
+        # of the scan by parallel/comm.layer_health_tap
+        probe0 = None
+        if self._layers_on:
+            from .comm import LAYER_PROBE_WIDTH
+            probe0 = jnp.zeros(
+                (self._layer_count, LAYER_PROBE_WIDTH), jnp.float32
+            )
+
+        def loss_fn(p, ix, tg, rng=None, probe=None):
             kw = {"rng": rng} if rng is not None else {}
+            if probe is not None:
+                kw["health_probe"] = probe
             l = self.model.apply(p, ix, tg, pctx=self.pctx, **kw)
             # loss scaling happens INSIDE the differentiated fn so the
             # whole backward runs on scaled values (fp16 AMP)
             return l * scale if scale is not None else l
 
         def loss_and_grads(p, ix, tg, rng=None):
+            """(loss, grads, probe cotangent or None)."""
             if self._use_1f1b:
                 # grads computed INSIDE the pipeline (per-tick vjp) — the
                 # 1F1B schedule can't be expressed through autodiff
-                return self.model.loss_and_grad_1f1b(
+                l, g = self.model.loss_and_grad_1f1b(
                     p, ix, tg, pctx=self.pctx,
                     loss_seed=scale if scale is not None else 1.0,
                     rng=rng,
                 )
-            return jax.value_and_grad(loss_fn)(p, ix, tg, rng)
+                return l, g, None
+            if self._layers_on:
+                l, (g, ps) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 4)
+                )(p, ix, tg, rng, probe0)
+                return l, g, ps
+            l, g = jax.value_and_grad(loss_fn)(p, ix, tg, rng)
+            return l, g, None
 
         new_residual = state.grad_residual
+        layer_probe = None
         if self._bucketed_active:
             # bucketed backward-overlapped release (grad_buckets > 1):
             # per-bucket collectives emitted inside the backward scan
@@ -1561,7 +1643,9 @@ class ZeroEngine:
                 state, idx, targets, rng, scale
             )
         elif self.accum_steps == 1:
-            loss, grads = loss_and_grads(params, idx, targets, rng)
+            loss, grads, layer_probe = loss_and_grads(
+                params, idx, targets, rng
+            )
         else:
             # Microbatch accumulation: batch is (accum, B, T) — the
             # reference's `require_backward_grad_sync` gating
@@ -1574,14 +1658,19 @@ class ZeroEngine:
             # accumulator per device, which is the point in the big-model
             # tight-HBM case accumulation exists for.
             def body(carry, mb):
-                acc_loss, acc_grads = carry
+                acc_loss, acc_grads, acc_probe = carry
                 ix, tg, mb_i = mb
                 mb_rng = (jax.random.fold_in(rng, mb_i)
                           if rng is not None else None)
-                l, g = loss_and_grads(params, ix, tg, mb_rng)
+                l, g, ps = loss_and_grads(params, ix, tg, mb_rng)
                 acc_grads = jax.tree.map(
                     lambda a, b: a + b.astype(jnp.float32), acc_grads, g
                 )
+                if ps is not None:
+                    # probe stats are raw sq-sums + counts, so summing
+                    # across microbatches keeps global-batch semantics
+                    # (norms taken once, in layer_health_matrix)
+                    acc_probe = acc_probe + ps
                 if self.stage >= 2:
                     # keep the f32 accumulator SHARDED across microbatches:
                     # each microbatch's grad reduce-scatters into the shard
@@ -1591,7 +1680,7 @@ class ZeroEngine:
                     acc_grads = self._constrain(
                         acc_grads, self._shard_shardings
                     )
-                return (acc_loss + l, acc_grads), None
+                return (acc_loss + l, acc_grads, acc_probe), None
 
             zero_grads = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params
@@ -1600,8 +1689,8 @@ class ZeroEngine:
                 zero_grads = self._constrain(
                     zero_grads, self._shard_shardings
                 )
-            (loss, grads), _ = jax.lax.scan(
-                body, (jnp.zeros((), jnp.float32), zero_grads),
+            (loss, grads, layer_probe), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zero_grads, probe0),
                 (idx, targets, jnp.arange(self.accum_steps)),
             )
             loss = loss / self.accum_steps
@@ -1620,6 +1709,13 @@ class ZeroEngine:
             loss = loss / scale
             if not (self._grad_comm_active or self._bucketed_active):
                 grads = _rescale(grads, 1.0 / scale)
+            if layer_probe is not None:
+                # the backward ran on the scaled loss: the dact sq-sum
+                # column (2) carries scale^2; the non-finite counts stay
+                # as observed (AMP overflow IS the scaled-backward truth)
+                layer_probe = layer_probe.at[:, 2].multiply(
+                    1.0 / (scale * scale)
+                )
         if dynamic:
             # finiteness judged on the UNSCALED grads, before clipping can
             # turn an inf norm into nans
@@ -1696,6 +1792,13 @@ class ZeroEngine:
             # inserts the cross-shard psum and the numbers are global
             from ..telemetry.health import health_vector
             aux = health_vector(loss, grads, params, new_params)
+            if self._layers_on:
+                # (n_layer, 6) layer-health matrix: the probe cotangent
+                # (act/dact stats from inside the scan) + per-layer grad
+                # stats read off the stacked "h.*" gradient leaves
+                from ..telemetry.health import layer_health_matrix
+                mat = layer_health_matrix(layer_probe, grads)
+                return new_state, loss, aux, mat
             return new_state, loss, aux
         return new_state, loss
 
@@ -1703,10 +1806,15 @@ class ZeroEngine:
         """One optimizer step.  batch = (idx, targets), each (B, T) int32 —
         or (accum, B, T) when accum_steps > 1.  Returns (state, loss)
         either way; with the telemetry knob the step's packed health
-        vector is pushed into the telemetry object un-synced."""
+        vector (and, in layers mode, the per-layer health matrix) is
+        pushed into the telemetry object un-synced."""
         if self._telemetry_on:
-            state, loss, aux = self._step(state, batch)
-            self.telemetry.on_step_output(aux)
+            if self._layers_on:
+                state, loss, aux, mat = self._step(state, batch)
+                self.telemetry.on_step_output(aux, layers=mat)
+            else:
+                state, loss, aux = self._step(state, batch)
+                self.telemetry.on_step_output(aux)
             return state, loss
         return self._step(state, batch)
 
@@ -1738,7 +1846,8 @@ class ZeroEngine:
         if self.offload_opt_state:
             extras += ", opt state offloaded=pinned_host"
         if self._telemetry_on:
-            extras += ", telemetry=on"
+            extras += (", telemetry=layers" if self._layers_on
+                       else ", telemetry=on")
         if self._grad_comm_active:
             extras += f", grad_comm={self.grad_comm}"
             if self.grad_comm_groups:

@@ -24,16 +24,34 @@ package instruments a training run end to end:
     `utils.profiling.MetricsLogger`; `scripts/report_run.py --check`
     validates files against it and `scripts/report_run.py RUN.jsonl`
     renders the markdown run report.
+  * `trace` — step-trace timeline assembly: measured wall segments +
+    schematic collective spans cross-referenced to the compiled HLO
+    ledger, exported as Chrome-trace JSON by `scripts/trace_view.py`.
+  * `flight` (FlightRecorder) — ring buffer of the last N steps' health
+    (+ per-layer health in layers mode), flushed as one `flight` JSONL
+    record when the anomaly detector fires on a slow step or non-finite
+    health.  `Telemetry(layers=True)` turns on the engine's per-layer
+    health mode (grad/activation norms + non-finite counts INSIDE the
+    block scan — the first-NaN layer localized in one step).
 """
 
-from .health import HEALTH_FIELDS, health_dict, health_vector
+from .health import (
+    HEALTH_FIELDS, LAYER_FIELDS, first_nonfinite_layer, health_dict,
+    health_vector,
+)
+from .flight import FlightRecorder
 from .registry import Telemetry
 from . import schema
+from . import trace
 
 __all__ = [
     "HEALTH_FIELDS",
+    "LAYER_FIELDS",
     "health_vector",
     "health_dict",
+    "first_nonfinite_layer",
+    "FlightRecorder",
     "Telemetry",
     "schema",
+    "trace",
 ]

@@ -1,0 +1,108 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Flight recorder: a ring buffer of the last N steps' health state,
+flushed to a JSONL `flight` record for postmortem.
+
+The anomaly path before this module was fire-one-xprof-trace-and-hope:
+when the rolling-median detector trips, the NEXT step runs under the
+profiler — the anomalous step itself is already gone, and a NaN step
+(which is not slow) never trips it at all.  The flight recorder keeps the
+RECENT PAST instead: every instrumented step appends its health vector,
+wall segments, and (in telemetry layers mode) the per-layer health matrix
+to a fixed-size ring; when the anomaly detector fires — on a slow step OR
+on non-finite health — the ring is flushed as one `kind="flight"` record
+(telemetry/schema.py) into the run's metrics JSONL, so the postmortem has
+the N steps LEADING UP to the event, not just the one after it.
+
+Hot-path contract: `record()` stores references only — device arrays (the
+layer matrix) are NOT synced; the single host transfer per step remains
+the health-vector sync that closes the step clock.  Only `flush()` (and
+`snapshot()`) materialize device data, and they run on the anomaly path,
+never per step (tests/test_trace_flight.py pins the no-sync property with
+a poisoned array stand-in).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+DEFAULT_CAPACITY = 64
+
+
+class FlightRecorder:
+    """Fixed-size ring of per-step entries; `flush()` writes them as one
+    `flight` meta record through a MetricsLogger."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"flight capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._buf: List[Optional[dict]] = [None] * self.capacity
+        self._n = 0          # total records ever (ring head = _n % capacity)
+        self.flushes = 0
+
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    def record(self, step: int, *, step_s: Optional[float] = None,
+               health: Optional[Dict[str, float]] = None,
+               segments: Optional[Dict[str, float]] = None,
+               layers=None) -> None:
+        """Append one step.  `health`/`segments` are host dicts (already
+        paid for by the step's own sync barrier); `layers` may be a DEVICE
+        array — it is stored as-is, un-synced (the no-sync hot-path
+        contract above)."""
+        self._buf[self._n % self.capacity] = {
+            "step": int(step),
+            "ts": time.time(),
+            "step_s": step_s,
+            "health": dict(health) if health else None,
+            "segments": dict(segments) if segments else None,
+            "layers": layers,
+        }
+        self._n += 1
+
+    def snapshot(self) -> List[dict]:
+        """Oldest-to-newest JSON-safe copies of the ring; device-array
+        layer matrices sync HERE (off the hot path) and gain a
+        `first_nonfinite_layer` localization."""
+        import numpy as np
+
+        from .health import first_nonfinite_layer
+
+        out = []
+        start = max(0, self._n - self.capacity)
+        for i in range(start, self._n):
+            e = dict(self._buf[i % self.capacity])
+            lay = e.pop("layers", None)
+            drop = [k for k, v in e.items() if v is None]
+            for k in drop:
+                del e[k]
+            if lay is not None:
+                mat = np.asarray(lay, dtype=np.float64)
+                e["layers"] = [[round(float(v), 6) for v in row]
+                               for row in mat]
+                src = first_nonfinite_layer(mat)
+                if src is not None:
+                    e["first_nonfinite_layer"] = src[0]
+                    e["nonfinite_field"] = src[1]
+            out.append(e)
+        return out
+
+    def flush(self, logger, reason: str, **extra) -> List[dict]:
+        """Write the ring as one `kind="flight"` meta record (schema.py)
+        and return the snapshot.  The ring is NOT cleared: a later, worse
+        anomaly still sees the steps between the two flushes."""
+        steps = self.snapshot()
+        rec = {"reason": reason, "steps": steps, **extra}
+        last_src = next(
+            (s["first_nonfinite_layer"] for s in reversed(steps)
+             if "first_nonfinite_layer" in s), None,
+        )
+        if last_src is not None:
+            rec.setdefault("first_nonfinite_layer", last_src)
+        logger.log_meta(kind="flight", **rec)
+        self.flushes += 1
+        return steps

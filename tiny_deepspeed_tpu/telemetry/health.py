@@ -68,3 +68,73 @@ def health_dict(vec) -> Dict[str, float]:
     out = {k: float(v) for k, v in zip(HEALTH_FIELDS, vals)}
     out["nonfinite_grads"] = int(out["nonfinite_grads"])
     return out
+
+
+# ---------------------------------------------------------------------------
+# per-layer health (engine telemetry layers mode)
+# ---------------------------------------------------------------------------
+
+# column order of the (n_layer, 6) layer-health matrix.  The first four
+# come from the in-scan probe tap (parallel/comm.layer_health_tap: forward
+# activation stats + backward activation-gradient stats); the last two are
+# computed from the stacked "h.*" gradient leaves after the backward (the
+# stacked layout already carries the per-layer split — no tap needed).
+LAYER_FIELDS = (
+    "act_norm", "act_nonfinite",
+    "dact_norm", "dact_nonfinite",
+    "grad_norm", "grad_nonfinite",
+)
+
+
+def layer_grad_stats(grads) -> jax.Array:
+    """(n_layer, 2) f32 [grad sq-sum, non-finite count] per layer, summed
+    over the stacked "h.*" gradient leaves (their leading axis IS the
+    layer axis).  Traced inside the step; under ZeRO-2/3 sharded grads
+    the sums are logical, so XLA psums across shards."""
+    gsq = nf = 0.0
+    for name, g in grads.items():
+        if not name.startswith("h."):
+            continue
+        gf = g.astype(jnp.float32)
+        axes = tuple(range(1, gf.ndim))
+        gsq = gsq + jnp.sum(jnp.square(gf), axis=axes)
+        nf = nf + jnp.sum(
+            (~jnp.isfinite(gf)).astype(jnp.float32), axis=axes
+        )
+    return jnp.stack([gsq, nf], axis=-1)
+
+
+def layer_health_matrix(probe_grad, grads) -> jax.Array:
+    """(n_layer, 6) f32 layer-health matrix (column order LAYER_FIELDS)
+    from the probe tap's cotangent ((L, 4): act/dact sq-sums + non-finite
+    counts) and the gradient tree.  Sq-sums become norms here, ONCE, so
+    microbatch accumulation can sum raw probe cotangents first."""
+    g = layer_grad_stats(grads)
+    return jnp.stack([
+        jnp.sqrt(probe_grad[:, 0]), probe_grad[:, 1],
+        jnp.sqrt(probe_grad[:, 2]), probe_grad[:, 3],
+        jnp.sqrt(g[:, 0]), g[:, 1],
+    ], axis=-1)
+
+
+def first_nonfinite_layer(mat):
+    """(layer index, LAYER_FIELDS column name) of the layer where
+    non-finiteness ORIGINATED, or None when every count is zero.
+    Host-side.  Resolution order mirrors propagation direction: a forward
+    overflow at layer k poisons activations k..L-1, so the source is the
+    FIRST layer with non-finite activations; a backward-only overflow
+    propagates toward layer 0, so the source is the LAST layer with
+    non-finite activation gradients; a dW-only overflow stays local, so
+    any layer with non-finite grads names itself."""
+    import numpy as np
+
+    m = np.asarray(mat)
+    act, dact, grad = m[:, 1], m[:, 3], m[:, 5]
+    if np.any(act > 0):
+        return int(np.argmax(act > 0)), "act_nonfinite"
+    if np.any(dact > 0):
+        return int(len(dact) - 1 - np.argmax(dact[::-1] > 0)), \
+            "dact_nonfinite"
+    if np.any(grad > 0):
+        return int(np.argmax(grad > 0)), "grad_nonfinite"
+    return None

@@ -73,12 +73,17 @@ class Histogram:
     def p95(self) -> float:
         return _quantile(self.values, 0.95)
 
+    @property
+    def p99(self) -> float:
+        return _quantile(self.values, 0.99)
+
     def snapshot(self) -> Dict[str, float]:
         return {
             "count": self.count,
             "mean": self.mean,
             "p50": self.p50,
             "p95": self.p95,
+            "p99": self.p99,
             "max": max(self.values) if self.values else 0.0,
         }
 
@@ -102,6 +107,8 @@ class Telemetry:
         anomaly_min_steps: int = 10,
         anomaly_window: int = 50,
         tracer=None,
+        layers: bool = False,
+        flight_steps: int = 64,
     ):
         self.timer = timer or StepTimer()
         self.timer.fetch_full = True
@@ -112,12 +119,29 @@ class Telemetry:
         self._tracer = tracer or (
             jax.profiler.start_trace, jax.profiler.stop_trace,
         )
+        # layers=True turns on the engine's per-layer health mode: the
+        # compiled step additionally returns the (n_layer, 6) layer-health
+        # matrix (telemetry/health.LAYER_FIELDS) the engine pushes into
+        # on_step_output(layers=...)
+        self.layers = bool(layers)
+        # flight recorder (telemetry/flight.py): ring of the last N steps'
+        # health + segments (+ layer matrices, un-synced), flushed as one
+        # `flight` JSONL record when the anomaly detector fires on a slow
+        # step or on non-finite health.  0 disables.
+        from .flight import FlightRecorder
+        self.flight = (
+            FlightRecorder(flight_steps) if flight_steps else None
+        )
+        self.flight_pending: Optional[str] = None
+        self._nonfinite_prev = False
         self.counters: Dict[str, Counter] = {}
         self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, Histogram] = {}
         self._engine = None
         self._last_aux = None
         self._last_health = None
+        self._last_layers = None
+        self._last_layers_host = None
         self._recent = []
         self._trace_armed = False
         self._trace_fired = False
@@ -157,11 +181,14 @@ class Telemetry:
         self._engine = engine
         self.timer.watch(engine)
 
-    def on_step_output(self, aux) -> None:
-        """Engine push: the step's packed health vector (device array, NOT
+    def on_step_output(self, aux, layers=None) -> None:
+        """Engine push: the step's packed health vector — and, in layers
+        mode, the (n_layer, 6) layer-health matrix (device arrays, NOT
         synced here)."""
         self._last_aux = aux
         self._last_health = None
+        self._last_layers = layers
+        self._last_layers_host = None
 
     def poll(self) -> Optional[Dict[str, float]]:
         """Host view of the latest health vector (one transfer, cached)."""
@@ -173,13 +200,26 @@ class Telemetry:
     def last_health(self) -> Optional[Dict[str, float]]:
         return self.poll()
 
+    def layer_health(self):
+        """Host view of the latest (n_layer, 6) layer-health matrix
+        (telemetry/health.LAYER_FIELDS columns), or None outside layers
+        mode.  One transfer, cached — call at inspection cadence; the
+        flight recorder keeps the un-synced device reference per step."""
+        if self._last_layers_host is None and self._last_layers is not None:
+            self._last_layers_host = np.asarray(self._last_layers)
+        return self._last_layers_host
+
     # -- the instrumented step ----------------------------------------------
 
     @contextlib.contextmanager
-    def step(self):
+    def step(self, index: Optional[int] = None):
         """Wrap one training step: timing + segment marks via the inner
         StepTimer handle, health-vector sync as the closing barrier, and
-        the armed anomaly trace if one is pending."""
+        the armed anomaly trace if one is pending.  `index` is the
+        caller's training iteration — the flight record numbers its
+        entries with it so a postmortem cross-references the step records
+        in the same JSONL (a resumed run starts at start_iter, not 0);
+        without it the internal steps counter is the fallback."""
         trace_now = (
             self._trace_armed and not self._trace_fired
             and self.trace_dir is not None
@@ -205,7 +245,7 @@ class Telemetry:
         if host is not None and len(host) == len(HEALTH_FIELDS):
             self._last_health = health_dict(host)
         dt = self.timer.times[-1]
-        self.counter("steps").inc()
+        n_step = self.counter("steps").inc()
         self.histogram("step_s").observe(dt)
         if self.timer.segments:
             for k, v in self.timer.segments[-1].items():
@@ -213,11 +253,40 @@ class Telemetry:
         if self.timer.compiled_steps[-1]:
             self.counter("compiles").inc(self.timer.compiled_steps[-1])
         self.note_step_time(dt)
+        h = self._last_health
+        if self.flight is not None:
+            # ring append only: host dicts (already paid for by the step's
+            # own sync) + the layer matrix as an UN-SYNCED device ref
+            self.flight.record(
+                index if index is not None else n_step - 1,
+                step_s=dt, health=h,
+                segments=self.timer.segments[-1]
+                if self.timer.segments else None,
+                layers=self._last_layers,
+            )
+        bad = h is not None and (
+            h["nonfinite_grads"] or not np.isfinite(h["loss"])
+        )
+        if bad and not self._nonfinite_prev:
+            # a NaN step is not SLOW, so the rolling-median detector never
+            # sees it — non-finite health arms the flight flush directly
+            # (and outranks a pending slow_step: the NaN postmortem is the
+            # more urgent record).  EDGE-triggered on the finite→bad
+            # transition: a run that stays NaN flushes once per episode,
+            # not one full ring per logging iteration
+            self.counter("anomalies_nonfinite").inc()
+            self.flight_pending = "nonfinite"
+        self._nonfinite_prev = bad
 
     def note_step_time(self, s: float) -> bool:
         """Feed one step wall time to the anomaly detector.  Returns True
         exactly once per run: the first time a step exceeds
-        `anomaly_factor` x the rolling median (after the warmup window)."""
+        `anomaly_factor` x the rolling median (after the warmup window).
+        Firing arms BOTH postmortem channels: the one-shot xprof trace of
+        the NEXT step and a flight-recorder flush of the PAST N steps
+        (maybe_flush_flight) — the anomalous step itself is gone, so the
+        trace covers what comes after and the flight record what led up
+        to it."""
         fired = False
         if (
             len(self._recent) >= self.anomaly_min_steps
@@ -229,11 +298,88 @@ class Telemetry:
                 self.counter("anomalies").inc()
                 self.gauge("anomaly_step_s", s)
                 self.gauge("anomaly_threshold_s", self.anomaly_factor * med)
+                if self.flight_pending is None:
+                    self.flight_pending = "slow_step"
                 fired = True
         self._recent.append(float(s))
         if len(self._recent) > self.anomaly_window:
             self._recent.pop(0)
         return fired
+
+    # -- flight recorder ----------------------------------------------------
+
+    def maybe_flush_flight(self, logger) -> Optional[str]:
+        """Flush the flight ring to `logger` as a `flight` record iff an
+        anomaly armed it (slow step or non-finite health).  Returns the
+        flush reason, or None when nothing was pending.  Call at logging
+        cadence (examples/common.py does, right after metrics.log) — the
+        flush syncs any recorded layer matrices, so it must stay OFF the
+        per-step hot path."""
+        if self.flight is None or self.flight_pending is None:
+            return None
+        reason = self.flight_pending
+        self.flight_pending = None
+        self.flight.flush(logger, reason)
+        self.counter("flight_flushes").inc()
+        return reason
+
+    # -- multi-host stragglers ----------------------------------------------
+
+    def sample_stragglers(self, step_s: Optional[float] = None,
+                          allgather=None,
+                          quantity: str = "step_s") -> Dict[str, object]:
+        """Per-host straggler attribution: all-gather one per-host wall
+        quantity over the mesh, gauge how much the slowest host drags the
+        others, and return the `straggler` record fields (schema.py).
+
+        WHICH quantity matters: an SPMD program's collectives couple
+        every host's DEVICE timeline, so whole-step wall converges to the
+        slowest host's pace on all hosts and attributes nothing — pass an
+        UNCOUPLED host-side measure for attribution (examples/common.py
+        gathers each host's data-load + staging wall per step, which is
+        pure host code and keeps the slow host visible).  `quantity`
+        labels what was gathered in the record.  `step_s` defaults to
+        this host's p50 step time (fine on one host; coupled on many).
+
+        `straggler_frac` = (slowest - median) / slowest — the FRACTION
+        of the slowest host's time the median host would not have spent:
+        0 on a balanced mesh, 2/3 when the slowest host takes 3x the
+        median, bounded [0, 1).  `allgather` injects the gather for
+        tests; the real path uses
+        jax.experimental.multihost_utils.process_allgather (single-
+        process runs short-circuit to a local list)."""
+        mine = float(
+            step_s if step_s is not None else self.timer.p50_s
+        )
+        if allgather is not None:
+            times = [float(v) for v in allgather(mine)]
+        elif jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            times = [
+                float(v) for v in np.asarray(
+                    multihost_utils.process_allgather(
+                        np.float32(mine)
+                    )
+                ).ravel()
+            ]
+        else:
+            times = [mine]
+        med = _quantile(sorted(times), 0.5)
+        slowest = int(np.argmax(times))
+        frac = (
+            (times[slowest] - med) / times[slowest]
+            if times[slowest] > 0 else 0.0
+        )
+        self.gauge("straggler_frac", frac)
+        self.gauge("straggler_slowest_host", slowest)
+        self.gauge("straggler_slowest_step_s", times[slowest])
+        return {
+            "hosts": len(times),
+            "quantity": quantity,
+            "step_s_by_host": [round(t, 6) for t in times],
+            "slowest_host": slowest,
+            "straggler_frac": round(frac, 6),
+        }
 
     # -- measured gauges ----------------------------------------------------
 
@@ -365,8 +511,10 @@ class Telemetry:
         """Assemble the run_meta record: engine identity + comm gauges +
         caller extras (model name, n_params, batch geometry, ...).
         `sample_batch` only provides shapes for the AOT lowering."""
+        from .schema import SCHEMA_VERSION
+
         engine = engine or self._engine
-        meta: Dict[str, object] = {}
+        meta: Dict[str, object] = {"schema_version": SCHEMA_VERSION}
         try:
             meta.update(self.capture_compiled(
                 state, sample_batch, engine=engine,
@@ -381,6 +529,17 @@ class Telemetry:
             )
         meta.update(extra)
         return meta
+
+    def trace_spans(self) -> Optional[list]:
+        """Schematic collective span template (telemetry/trace.py) from
+        the last `capture_compiled` ledger, or None before one ran — the
+        payload of the `trace` meta record that `scripts/trace_view.py`
+        joins with the per-step wall segments into a Chrome-trace
+        timeline."""
+        if not self._comm or "comm_measured" not in self._comm:
+            return None
+        from .trace import collective_span_template
+        return collective_span_template(self._comm["comm_measured"])
 
     # -- sinks --------------------------------------------------------------
 

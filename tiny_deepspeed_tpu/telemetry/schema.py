@@ -4,12 +4,12 @@
 """The JSONL metrics schema: one source of truth for what a run's metrics
 file may contain.
 
-Two record kinds share a file:
+Two record classes share a file:
 
   * step records   — `MetricsLogger.log(step, **fields)`:
                      {"step": int, "ts": float, ...optional fields}
   * meta records   — `MetricsLogger.log_meta(kind=..., **fields)`:
-                     {"kind": "run_meta"|"telemetry_summary", "ts": float,
+                     {"kind": one of META_KINDS, "ts": float,
                       ...optional fields}
 
 `scripts/report_run.py --check` validates a file against this module and
@@ -22,9 +22,19 @@ smoke-runs it in tier-1).
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 _NUM = (int, float)
+
+# Version of this schema, stamped into every `run_meta` record
+# (Telemetry.run_meta / bench.py's sidecar).  Bump it when record kinds or
+# fields change so `report_run.py --check` can WARN when a file was
+# written by a different schema vintage (a mismatch is advisory — the
+# field-level validation below is what hard-fails).
+#   1: step + run_meta/telemetry_summary records (PR "In-step telemetry")
+#   2: + trace / flight / straggler meta kinds, schema_version stamp,
+#      per-layer health fields (this PR)
+SCHEMA_VERSION = 2
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -50,12 +60,40 @@ STEP_FIELDS: Dict[str, tuple] = {
     "anomaly_trace": str,
 }
 
-META_KINDS = ("run_meta", "telemetry_summary")
+META_KINDS = (
+    "run_meta", "telemetry_summary",
+    # schematic collective span template from the compiled step's HLO
+    # ledger (telemetry/trace.py; rendered by scripts/trace_view.py)
+    "trace",
+    # flight-recorder flush: the last N steps' health vectors + wall
+    # segments (+ per-layer health), written when the anomaly detector
+    # fires (telemetry/flight.py)
+    "flight",
+    # multi-host straggler attribution (Telemetry.sample_stragglers)
+    "straggler",
+)
 
 META_FIELDS: Dict[str, tuple] = {
     "engine": str,
     "stage": int,
     "devices": int,
+    # SCHEMA_VERSION stamp (run_meta; --check warns on mismatch)
+    "schema_version": int,
+    # trace record: the collective span template
+    "spans": list,
+    # flight record (telemetry/flight.py)
+    "reason": str,
+    "steps": list,
+    "first_nonfinite_layer": int,
+    # straggler record (Telemetry.sample_stragglers)
+    "hosts": int,
+    # what step_s_by_host measures ("step_s", "host_prep_s", ...): SPMD
+    # collectives couple whole-step wall across hosts, so attribution
+    # gathers an uncoupled host-side quantity and labels it here
+    "quantity": str,
+    "step_s_by_host": list,
+    "slowest_host": int,
+    "straggler_frac": _NUM,
     "model": str,
     "n_params": _NUM,
     "tokens_per_step": _NUM,
@@ -148,3 +186,62 @@ def validate_file(path: str) -> Tuple[Dict[str, int], List[str]]:
             if not line_errs:
                 counts["meta" if "kind" in rec else "step"] += 1
     return counts, errs
+
+
+def version_warning(metas) -> Optional[str]:
+    """Advisory schema-vintage check over parsed meta records: a warning
+    string when a run_meta's `schema_version` differs from this module's
+    (or predates the stamp entirely), else None.  `report_run.py --check`
+    prints it to stderr without failing — field validation is the hard
+    gate; the version is provenance."""
+    for m in metas:
+        if not isinstance(m, dict) or m.get("kind") != "run_meta":
+            continue
+        v = m.get("schema_version")
+        if v is None:
+            return (
+                "run_meta carries no schema_version (pre-v2 writer); "
+                f"current schema is v{SCHEMA_VERSION}"
+            )
+        if v != SCHEMA_VERSION:
+            return (
+                f"run_meta written by schema v{v}; this checker is "
+                f"v{SCHEMA_VERSION} — fields may have drifted"
+            )
+        return None
+    return None
+
+
+# Telemetry GAUGE name registry: every `telemetry.gauge("<name>", ...)`
+# call site in the package must have its name documented here — the
+# repo-hygiene name-drift guard (tests/test_repo_hygiene.py) greps the
+# call sites and fails on an undocumented gauge, so a renamed or new
+# gauge cannot silently desynchronize dashboards from the code.
+GAUGES: Dict[str, str] = {
+    "anomaly_step_s": "wall time of the step that tripped the anomaly "
+                      "detector",
+    "anomaly_threshold_s": "rolling-median threshold the anomalous step "
+                           "exceeded",
+    "hbm_gb_in_use": "device memory in use at the last sample (TPU "
+                     "runtime)",
+    "hbm_gb_peak": "peak device-memory watermark seen this run",
+    "grad_residual_norm": "L2 norm of the quantized-grad-comm error-"
+                          "feedback residual (TrainState.grad_residual)",
+    "grad_comm_overlap_frac": "loop-resident / total reducing-collective "
+                              "wire bytes (hlo_comm.overlap_report)",
+    "gather_overlap_frac": "loop-resident / total all-gather wire bytes "
+                           "(the ZeRO-3 weight-gather placement)",
+    "measured_wire_bytes": "total per-device collective wire bytes from "
+                           "the compiled HLO ledger",
+    "modeled_wire_bytes": "comm_report ring-model prediction for the same",
+    "grad_comm_wire_bytes": "modeled wire bytes of the quantized gradient "
+                            "schedule",
+    "grad_comm_wire_saved_bytes": "modeled wire saved vs the fp32 "
+                                  "all-reduce baseline",
+    "aot_temp_bytes": "AOT-predicted step temp allocation",
+    "straggler_frac": "(slowest - median) / slowest over the gathered "
+                      "per-host wall — the [0,1) fraction of the slowest "
+                      "host's time the median host would not have spent",
+    "straggler_slowest_host": "process index of the slowest host",
+    "straggler_slowest_step_s": "the slowest host's step wall time",
+}

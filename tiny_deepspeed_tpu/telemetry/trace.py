@@ -1,0 +1,261 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Step-trace timeline: Chrome-trace / Perfetto span assembly.
+
+`scripts/report_run.py` answers "how fast and how healthy"; this module
+answers "WHERE inside a step" — the trace-timeline view production TPU
+stacks debug performance with (cf. the per-stage timeline analysis in
+arXiv:2412.14374).  Two span sources join into one timeline:
+
+  * **measured wall segments** per step — the StepTimer `mark()` splits
+    already in every step record (`data_s` loader wait, `h2d_s` staging,
+    `compute_s` device dispatch + sync).  These are real host-clock
+    windows.
+  * **schematic collective spans** — the compiled step's HLO collective
+    ledger (`utils/hlo_comm.py`) split by (op, loop residency), each span
+    cross-referenced to its ledger entry: wire bytes, op count, per-dtype
+    wire split, and the loop-resident flag (= issued inside the layer
+    scan, where the scheduler can hide its wire behind compute).  The
+    host cannot clock device-internal phases, so these spans subdivide
+    each step's `compute_s` window PROPORTIONALLY BY WIRE BYTES — their
+    widths are schematic (every span carries "schematic": true), their
+    byte/count annotations are exact ledger values.
+
+`scripts/trace_view.py` turns a run's metrics JSONL into Chrome-trace
+JSON (chrome://tracing, https://ui.perfetto.dev) using this module; the
+`trace` meta record (schema.py) persists the span template so the viewer
+needs no recompile.  tests/test_trace_flight.py pins that every
+loop-resident span's wire bytes match the ledger.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+# friendly names per (op, loop_resident): what the schedule MEANS in this
+# codebase — reducing collectives inside the scan are the bucketed/implicit
+# grad release, top-level ones the post-backward sync; all-gathers inside
+# the scan are the ZeRO-3 per-layer weight gathers, top-level ones the
+# ZeRO-1/2 param broadcast
+_SPAN_LABELS = {
+    ("all-reduce", True): "grad all-reduce (in-scan)",
+    ("all-reduce", False): "grad all-reduce (post-backward)",
+    ("reduce-scatter", True): "grad reduce-scatter (in-scan)",
+    ("reduce-scatter", False): "grad reduce-scatter (post-backward)",
+    # all-to-all is the quantized grad schedule's hop when grad_comm is
+    # on — but GSPMD also emits it for plain reshards, so the label stays
+    # op-literal (the args carry the exact bytes either way)
+    ("all-to-all", True): "all-to-all (in-scan)",
+    ("all-to-all", False): "all-to-all (post-backward)",
+    ("all-gather", True): "weight gather (in-scan)",
+    ("all-gather", False): "param broadcast (all-gather)",
+    ("collective-permute", True): "ring/pipeline permute (in-scan)",
+    ("collective-permute", False): "ring/pipeline permute",
+}
+
+
+def collective_span_template(measured: Dict[str, object]) -> List[dict]:
+    """Schematic span template from a `ledger_summary` dict: one span per
+    (collective op, placement), loop-resident first.  Each span:
+
+      {"name", "op", "loop_resident", "wire_bytes", "count",
+       "wire_bytes_by_dtype", "schematic": True}
+
+    `wire_bytes` is the EXACT ledger value for that (op, placement) —
+    the cross-reference tests pin.  The per-dtype split is the op's whole
+    split (the ledger does not subdivide it by placement).  Async
+    start→done window data lives in the `run_meta` record's
+    `comm_overlap` field in the same JSONL, not here."""
+    spans: List[dict] = []
+    wire = measured.get("wire_bytes", {}) or {}
+    in_loop = measured.get("wire_bytes_in_loops", {}) or {}
+    counts = measured.get("count", {}) or {}
+    loop_counts = measured.get("count_in_loops", {}) or {}
+    by_op_dtype = measured.get("wire_bytes_by_op_dtype", {}) or {}
+    for op in sorted(wire):
+        total = float(wire[op])
+        loop_w = float(in_loop.get(op, 0.0))
+        top_w = total - loop_w
+        n_loop = float(loop_counts.get(op, 0.0))
+        n_top = float(counts.get(op, 0.0)) - n_loop
+        for resident, w, n in ((True, loop_w, n_loop),
+                               (False, top_w, n_top)):
+            if w <= 0.0 and n <= 0.0:
+                continue
+            spans.append({
+                "name": _SPAN_LABELS.get((op, resident), op),
+                "op": op,
+                "loop_resident": resident,
+                "wire_bytes": round(w, 3),
+                "count": round(n, 3),
+                "wire_bytes_by_dtype": {
+                    k: round(float(v), 3)
+                    for k, v in by_op_dtype.get(op, {}).items()
+                },
+                "schematic": True,
+            })
+    # loop-resident spans lead: they are issued before the scan finishes
+    spans.sort(key=lambda s: (not s["loop_resident"], s["op"]))
+    return spans
+
+
+def load_run(path: str) -> Tuple[List[dict], List[dict], List[str]]:
+    """(meta records, step records, parse errors) from a metrics JSONL —
+    the report_run.py loader contract, shared here so trace_view.py and
+    report_run.py read files identically."""
+    metas, steps, errs = [], [], []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errs.append(f"line {i}: invalid JSON ({e})")
+                continue
+            (metas if isinstance(rec, dict) and "kind" in rec
+             else steps).append(rec)
+    return metas, steps, errs
+
+
+def _find(metas: List[dict], kind: str) -> Optional[dict]:
+    for m in metas:
+        if m.get("kind") == kind:
+            return m
+    return None
+
+
+_SEG_NAMES = {
+    "data_s": "data wait",
+    "h2d_s": "host->device",
+    "compute_s": "device compute (+sync)",
+}
+
+
+def _json_safe(v):
+    """Non-finite floats become their string names: Python's json happily
+    writes bare `NaN`, but chrome://tracing and Perfetto parse STRICT
+    JSON and would reject the whole file — exactly on the NaN-postmortem
+    runs this timeline exists for."""
+    if isinstance(v, float) and not (v == v and abs(v) != float("inf")):
+        return str(v)
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_json_safe(x) for x in v]
+    return v
+
+# Chrome-trace track (tid) layout
+_TID_STEP = 0        # whole-step spans
+_TID_SEG = 1         # wall segments
+_TID_COMM = 2        # schematic collective spans
+
+
+def chrome_trace(metas: List[dict], steps: List[dict],
+                 source: str = "") -> Dict[str, object]:
+    """Chrome-trace JSON (the `traceEvents` array format) for one run's
+    records: per step a whole-step span + its wall segments on real
+    host-clock time, and the collective span template instantiated inside
+    each step's compute window (widths proportional to wire bytes,
+    schematic).  Timestamps are microseconds from the first record."""
+    spans = None
+    tr = _find(metas, "trace")
+    if tr is not None:
+        spans = tr.get("spans")
+    if spans is None:
+        run = _find(metas, "run_meta") or {}
+        measured = run.get("comm_measured")
+        if measured:
+            spans = collective_span_template(measured)
+    spans = spans or []
+    total_wire = sum(s.get("wire_bytes", 0.0) for s in spans) or 1.0
+
+    events: List[dict] = [
+        {"ph": "M", "pid": 0, "name": "process_name",
+         "args": {"name": f"tiny-deepspeed-tpu run {source}".strip()}},
+        {"ph": "M", "pid": 0, "tid": _TID_STEP, "name": "thread_name",
+         "args": {"name": "step"}},
+        {"ph": "M", "pid": 0, "tid": _TID_SEG, "name": "thread_name",
+         "args": {"name": "host wall segments"}},
+        {"ph": "M", "pid": 0, "tid": _TID_COMM, "name": "thread_name",
+         "args": {"name": "collectives (schematic, HLO ledger)"}},
+    ]
+
+    timed = [r for r in steps if isinstance(r.get("ts"), (int, float))
+             and isinstance(r.get("step_s"), (int, float))]
+    t0 = min((r["ts"] - r["step_s"] for r in timed), default=0.0)
+
+    def us(seconds: float) -> float:
+        return round(seconds * 1e6, 3)
+
+    for rec in timed:
+        start = rec["ts"] - rec["step_s"] - t0
+        dur = rec["step_s"]
+        step_i = rec.get("step", 0)
+        events.append({
+            "ph": "X", "pid": 0, "tid": _TID_STEP,
+            "name": f"step {step_i}",
+            "ts": us(start), "dur": us(dur),
+            "args": _json_safe({
+                k: rec[k] for k in
+                ("loss", "tokens_per_s", "grad_norm", "nonfinite_grads",
+                 "compiled")
+                if k in rec
+            }),
+        })
+        cursor = start
+        compute_win = (start, dur)
+        for key in ("data_s", "h2d_s", "compute_s"):
+            seg = rec.get(key)
+            if not isinstance(seg, (int, float)):
+                continue
+            events.append({
+                "ph": "X", "pid": 0, "tid": _TID_SEG,
+                "name": _SEG_NAMES[key],
+                "ts": us(cursor), "dur": us(seg),
+                "args": {"seconds": seg},
+            })
+            if key == "compute_s":
+                compute_win = (cursor, seg)
+            cursor += seg
+        # schematic collective sub-spans fill the compute window
+        # proportionally by wire bytes — widths schematic, byte/count
+        # args exact ledger values
+        c0, cdur = compute_win
+        ccursor = c0
+        for sp in spans:
+            w = float(sp.get("wire_bytes", 0.0))
+            sdur = cdur * w / total_wire
+            events.append({
+                "ph": "X", "pid": 0, "tid": _TID_COMM,
+                "name": sp.get("name", sp.get("op", "collective")),
+                "ts": us(ccursor), "dur": us(sdur),
+                "args": _json_safe(
+                    {k: v for k, v in sp.items() if k != "name"}
+                ),
+            })
+            ccursor += sdur
+
+    flight = _find(metas, "flight")
+    if flight is not None:
+        # instant event marking the flush (the anomaly's log-time stamp)
+        events.append({
+            "ph": "i", "pid": 0, "tid": _TID_STEP, "s": "g",
+            "name": f"flight flush ({flight.get('reason', '?')})",
+            "ts": us(max((r["ts"] - t0 for r in timed), default=0.0)),
+        })
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": source,
+            "schematic_collectives": bool(spans),
+            "spans_total_wire_bytes": round(float(sum(
+                s.get("wire_bytes", 0.0) for s in spans
+            )), 3),
+        },
+    }

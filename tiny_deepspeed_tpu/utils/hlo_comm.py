@@ -346,6 +346,7 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
     payload: Dict[str, float] = {}
     wire: Dict[str, float] = {}
     wire_by_dtype: Dict[str, float] = {}
+    wire_by_op_dtype: Dict[str, Dict[str, float]] = {}
     count: Dict[str, float] = {}
     wire_in_loops: Dict[str, float] = {}
     count_in_loops: Dict[str, float] = {}
@@ -378,11 +379,15 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                 count_in_loops[op] = count_in_loops.get(op, 0.0) + mult
             if b:
                 # the ring formulas above are linear in the payload, so
-                # the per-dtype wire split is just proportional
+                # the per-dtype wire split is just proportional; kept both
+                # globally and per op (the per-op split is what lets a
+                # trace span say WHICH precision its wire moved at —
+                # telemetry/trace.collective_span_template)
+                per_op = wire_by_op_dtype.setdefault(op, {})
                 for dt, db in by_dt.items():
-                    wire_by_dtype[dt] = (
-                        wire_by_dtype.get(dt, 0.0) + mult * w * db / b
-                    )
+                    share = mult * w * db / b
+                    wire_by_dtype[dt] = wire_by_dtype.get(dt, 0.0) + share
+                    per_op[dt] = per_op.get(dt, 0.0) + share
         for child, trips, kind in edges.get(comp, []):
             walk(child, mult * trips, seen + (comp,),
                  in_loop or kind.startswith("while"))
@@ -394,6 +399,7 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
         "payload_bytes": payload,
         "wire_bytes": wire,
         "wire_bytes_by_dtype": wire_by_dtype,
+        "wire_bytes_by_op_dtype": wire_by_op_dtype,
         "count": count,
         "wire_bytes_in_loops": wire_in_loops,
         "count_in_loops": count_in_loops,
@@ -560,9 +566,17 @@ def ledger_summary(led: Dict[str, object]) -> Dict[str, object]:
             k: float(v)
             for k, v in led.get("wire_bytes_by_dtype", {}).items()
         },
+        "wire_bytes_by_op_dtype": {
+            op: {k: float(v) for k, v in per.items()}
+            for op, per in led.get("wire_bytes_by_op_dtype", {}).items()
+        },
         "wire_bytes_in_loops": {
             k: float(v)
             for k, v in led.get("wire_bytes_in_loops", {}).items()
+        },
+        "count_in_loops": {
+            k: float(v)
+            for k, v in led.get("count_in_loops", {}).items()
         },
         "count": {k: float(v) for k, v in led["count"].items()},
         "total_wire_bytes": float(led["total_wire_bytes"]),

@@ -76,7 +76,8 @@ class StepTimer:
         NEW lowerings the watched jit cache grew by (`self.compiled_steps`),
         so first-compile and shape-driven recompiles are attributed to the
         step that paid for them.
-      * `p50_s` / `p95_s` percentile properties next to `mean_s`.
+      * `p50_s` / `p95_s` / `p99_s` / `max_s` tail properties next to
+        `mean_s`.
       * a step whose body RAISES clears the observed output instead of
         leaking it into the next step's sync, and records no sample.
       * `fetch_full=True` makes the closing sync materialize the whole
@@ -189,6 +190,17 @@ class StepTimer:
     @property
     def p95_s(self) -> float:
         return _quantile(self._sample(), 0.95)
+
+    @property
+    def p99_s(self) -> float:
+        return _quantile(self._sample(), 0.99)
+
+    @property
+    def max_s(self) -> float:
+        """Worst warm step — with p99, the tail the straggler/anomaly
+        analysis cares about (the p50/p95 pair hides a single stall)."""
+        xs = self._sample()
+        return max(xs) if xs else 0.0
 
     @property
     def compile_count(self) -> int:
