@@ -278,17 +278,44 @@ def parse_args(default_model="gpt2-124m", **defaults):
     )
     p.add_argument(
         "--save-every", type=int, default=0, metavar="N",
-        help="write a sharded Orbax checkpoint of the TrainState every N "
-             "iters into --save-dir (reference has no checkpointing, "
-             "SURVEY §5.4)",
+        help="legacy alias of --checkpoint-every",
     )
-    p.add_argument("--save-dir", default="checkpoints", metavar="DIR")
+    p.add_argument("--save-dir", default="checkpoints", metavar="DIR",
+                   help="legacy alias of --checkpoint-dir")
+    p.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="N",
+        help="commit a sharded Orbax checkpoint of the TrainState every N "
+             "iters into --checkpoint-dir — atomically (tmp-dir + rename "
+             "+ COMMITTED marker: a crash mid-save can never corrupt the "
+             "resume chain), asynchronously (the Orbax write overlaps the "
+             "next steps), with retry/backoff on transient I/O failure, "
+             "and ADAPTIVELY: with --telemetry, an anomaly (step-time "
+             "spike or non-finite health) checkpoints immediately — "
+             "non-finite states go to <dir>/postmortem/, outside the "
+             "resume chain.  SIGTERM (preemption notice) drains one final "
+             "committed checkpoint before exit "
+             "(tiny_deepspeed_tpu/resilience/)",
+    )
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="checkpoint directory (default: --save-dir, i.e. "
+                        "'checkpoints')")
+    p.add_argument(
+        "--checkpoint-sync", action="store_true",
+        help="write checkpoints synchronously (the async writer overlaps "
+             "Orbax I/O with training steps; sync trades that overlap "
+             "for a strict save-then-step ordering)",
+    )
     p.add_argument(
         "--resume", action="store_true",
-        help="resume from the latest checkpoint in --save-dir (restores "
-             "params+optimizer state into the engine's shardings and "
-             "fast-forwards the data stream, so the loss trajectory matches "
-             "an uninterrupted run)",
+        help="resume from the latest COMMITTED checkpoint in "
+             "--checkpoint-dir (restores params+optimizer state into the "
+             "engine's shardings and fast-forwards the data stream to the "
+             "saved global sample offset, so the loss trajectory matches "
+             "an uninterrupted run).  Elastic: a checkpoint saved on a "
+             "DIFFERENT device count restores onto this run's mesh — "
+             "partition tables and shardings are re-derived for the new "
+             "topology (data-axis reshaping only; pipeline/expert/TP/SP "
+             "configs are refused loudly)",
     )
     if defaults:
         p.set_defaults(**defaults)
@@ -427,21 +454,30 @@ def run(engine_cls, args, single_device=False):
     b = args.batch_per_device * n_dev
     vocab = model.config.vocab_size
 
+    ckpt_dir = getattr(args, "checkpoint_dir", None) or args.save_dir
+    ckpt_every = getattr(args, "checkpoint_every", 0) \
+        or getattr(args, "save_every", 0)
+
     start_iter = 0
     resume_step = None
+    resume_info = None
     if getattr(args, "resume", False):
-        from tiny_deepspeed_tpu.utils.checkpoint import (
-            latest_step, load_checkpoint,
-        )
-        resume_step = latest_step(args.save_dir)
+        from tiny_deepspeed_tpu.utils.checkpoint import latest_step
+        resume_step = latest_step(ckpt_dir)
     if resume_step is not None:
         # restore INSTEAD of init — materializing a fresh TrainState first
         # would double peak state memory exactly on the near-HBM-limit runs
-        # checkpointing exists for
-        state = load_checkpoint(args.save_dir, engine, step=resume_step)
+        # checkpointing exists for.  elastic_load tolerates a different
+        # device count than the checkpoint was saved on (data-axis only;
+        # pipeline/expert/TP/SP configs are refused with both shapes).
+        from tiny_deepspeed_tpu.resilience import elastic_load
+        state, resume_info = elastic_load(ckpt_dir, engine,
+                                          step=resume_step)
         start_iter = resume_step
         if jax.process_index() == 0:
-            print(f"resumed from {args.save_dir} at iter {resume_step}")
+            el = " (elastic: mesh changed)" if resume_info["elastic"] \
+                else ""
+            print(f"resumed from {ckpt_dir} at iter {resume_step}{el}")
     else:
         state = engine.init(jax.random.PRNGKey(args.seed))
 
@@ -449,10 +485,47 @@ def run(engine_cls, args, single_device=False):
     # before the device asks — the reference rebuilds tensors on the host
     # inside the loop (example/ddp/train.py:23-24).
     from tiny_deepspeed_tpu.data import TokenLoader
+    indexed = False
+    seek = 0
+    if start_iter:
+        # replay position -> trajectory continuity.  With an UNCHANGED
+        # global batch the per-batch stream replays bit-exactly from the
+        # saved sample offset; legacy checkpoints without meta fall back
+        # to step-count replay (same stream iff the batch is unchanged).
+        # A CHANGED global batch has no per-batch continuation at all —
+        # that stream is keyed by (batch counter, batch size) — so the
+        # run switches to the per-sample indexed stream at the saved
+        # offset: deterministic, batch-size invariant from here on, and
+        # recorded in the meta so later resumes stay on it.
+        from tiny_deepspeed_tpu.resilience import data_offset_batches
+        data = (resume_info or {}).get("data") or {}
+        saved_b = data.get("global_batch")
+        if data.get("indexed") or (saved_b is not None
+                                   and int(saved_b) != b):
+            seek = int(data["samples_seen"])
+            indexed = True
+            if jax.process_index() == 0 and not data.get("indexed"):
+                print(f"resume: global batch changed {int(saved_b)} -> "
+                      f"{b}; continuing on the indexed per-sample "
+                      f"stream at offset {seek}")
+        else:
+            try:
+                off = (data_offset_batches(resume_info, b)
+                       if resume_info else None)
+                seek = (off if off is not None else start_iter) * b
+            except ValueError:
+                # same nominal batch but a misaligned offset (e.g. a
+                # checkpoint hand-written mid-batch): the indexed stream
+                # accepts any offset
+                seek = int(data["samples_seen"])
+                indexed = True
+                if jax.process_index() == 0:
+                    print(f"resume offset {seek} samples not divisible "
+                          f"by global batch {b}: using indexed loader")
     loader = TokenLoader(args.data, batch=b, seq=args.seq_len,
-                         vocab_size=vocab, seed=args.seed)
-    for _ in range(start_iter):  # replay position -> trajectory continuity
-        loader.next()
+                         vocab_size=vocab, seed=args.seed, indexed=indexed)
+    if seek:
+        loader.seek_samples(seek)
 
     if getattr(args, "autotune", None) is not None:
         if jax.process_count() > 1:
@@ -487,16 +560,39 @@ def run(engine_cls, args, single_device=False):
             # it; drop the probe state FIRST (holding both would double
             # peak state memory exactly on near-HBM-limit runs)
             state = None
-            state = (load_checkpoint(args.save_dir, engine,
-                                     step=resume_step)
-                     if resume_step is not None
-                     else engine.init(jax.random.PRNGKey(args.seed)))
+            if resume_step is not None:
+                from tiny_deepspeed_tpu.resilience import elastic_load
+                state, _ = elastic_load(ckpt_dir, engine, step=resume_step)
+            else:
+                state = engine.init(jax.random.PRNGKey(args.seed))
 
     metrics = None
     if getattr(args, "metrics", None):
         from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
         metrics = MetricsLogger(args.metrics, stdout=False)
     profile_dir = getattr(args, "profile", None)
+
+    # preemption-safe checkpoint cadence (tiny_deepspeed_tpu/resilience/):
+    # async atomic saves on the interval + immediately on a telemetry
+    # anomaly; a SIGTERM (the preemption notice) drains one final
+    # committed checkpoint between steps instead of dying mid-save
+    manager = guard = None
+    if ckpt_every:
+        from tiny_deepspeed_tpu.resilience import (
+            CheckpointManager, PreemptionGuard,
+        )
+        manager = CheckpointManager(
+            ckpt_dir, every=ckpt_every, engine=engine, telemetry=telem,
+            async_save=not getattr(args, "checkpoint_sync", False),
+        )
+        guard = PreemptionGuard()
+    if metrics is not None and resume_info is not None:
+        metrics.log_meta(kind="resume", checkpoint_dir=ckpt_dir,
+                         **resume_info)
+
+    def _data_meta():
+        return {"samples_seen": loader.samples_seen, "global_batch": b,
+                "seed": args.seed, "indexed": loader.indexed}
 
     eval_every = getattr(args, "eval_every", 0)
     val_loader = None
@@ -515,87 +611,128 @@ def run(engine_cls, args, single_device=False):
     # wall converges to the slowest host on every host), so only an
     # uncoupled host-side measure can attribute a straggler
     host_prep_s = 0.0
-    for it in range(start_iter, args.iters):
-        it_t0 = time.perf_counter()
-        if profile_dir is not None and it == start_iter + 2:
-            jax.profiler.start_trace(profile_dir)
-            trace_started = True
-        if telem is not None and rank0:
-            # instrumented step: wall segments (data wait / host->device /
-            # compute), recompile attribution, and the health-vector sync
-            # as the closing barrier — ONE device->host transfer delivers
-            # loss + grad/update/param norms + non-finite counts.  Rank 0
-            # only: the barrier would cost the other ranks the run-ahead
-            # overlap the plain path preserves (their engine.step still
-            # pushes the aux un-synced; the compiled program is identical
-            # on every rank)
-            with telem.step(index=it) as t:
-                idx, tgt = loader.next()
-                t.mark("data")
-                batch = (jnp.asarray(idx), jnp.asarray(tgt))
-                t.mark("h2d")
-                host_prep_s += time.perf_counter() - it_t0
-                state, loss = engine.step(state, batch)
-            ran += 1
-            health = telem.last_health
-            loss_f = (health["loss"] if health is not None
-                      else float(loss))
-            it_dt = telem.timer.times[-1]
-            print(f"iter {it:3d} loss {loss_f:.4f}")
-            if metrics is not None:
-                metrics.log(
-                    it, loss=loss_f, step_s=it_dt,
-                    tokens_per_s=b * args.seq_len / max(it_dt, 1e-9),
-                    **telem.step_record(),
-                )
-                # anomaly-armed flight flush (slow step or non-finite
-                # health): the last N steps' history lands as ONE
-                # 'flight' record; syncs any per-layer matrices, so it
-                # stays here at logging cadence, off the step hot path
-                reason = telem.maybe_flush_flight(metrics)
-                if reason is not None:
-                    print(f"iter {it:3d} flight record flushed "
-                          f"(reason: {reason})")
-        else:
-            idx, tgt = loader.next()
-            batch = (jnp.asarray(idx), jnp.asarray(tgt))
-            host_prep_s += time.perf_counter() - it_t0
-            state, loss = engine.step(state, batch)
-            ran += 1
-            if rank0:
-                # device->host sync (axon-safe barrier) only where the
-                # value is consumed — other ranks run ahead and overlap
-                # loader.next() with device compute (MetricsLogger.log is
-                # rank-0 gated too)
-                loss_f = float(loss)
-                it_dt = time.perf_counter() - it_t0
+    try:
+        for it in range(start_iter, args.iters):
+            it_t0 = time.perf_counter()
+            flight_reason = None
+            if profile_dir is not None and it == start_iter + 2:
+                jax.profiler.start_trace(profile_dir)
+                trace_started = True
+            if telem is not None and rank0:
+                # instrumented step: wall segments (data wait / host->device /
+                # compute), recompile attribution, and the health-vector sync
+                # as the closing barrier — ONE device->host transfer delivers
+                # loss + grad/update/param norms + non-finite counts.  Rank 0
+                # only: the barrier would cost the other ranks the run-ahead
+                # overlap the plain path preserves (their engine.step still
+                # pushes the aux un-synced; the compiled program is identical
+                # on every rank)
+                with telem.step(index=it) as t:
+                    idx, tgt = loader.next()
+                    t.mark("data")
+                    batch = (jnp.asarray(idx), jnp.asarray(tgt))
+                    t.mark("h2d")
+                    host_prep_s += time.perf_counter() - it_t0
+                    state, loss = engine.step(state, batch)
+                ran += 1
+                health = telem.last_health
+                loss_f = (health["loss"] if health is not None
+                          else float(loss))
+                it_dt = telem.timer.times[-1]
                 print(f"iter {it:3d} loss {loss_f:.4f}")
                 if metrics is not None:
-                    metrics.log(it, loss=loss_f, step_s=it_dt,
-                                tokens_per_s=b * args.seq_len
-                                / max(it_dt, 1e-9))
-        if trace_started and it == start_iter + 4:
-            jax.profiler.stop_trace()
-            trace_started = False
-            if rank0:
-                print(f"profiler trace written to {profile_dir}")
-        if eval_every and (it + 1) % eval_every == 0:
-            vals = []
-            for _ in range(args.eval_batches):
-                vix, vtg = val_loader.next()
-                vals.append(engine.eval_loss(
-                    state, (jnp.asarray(vix), jnp.asarray(vtg))
-                ))
-            vloss = sum(float(v) for v in vals) / len(vals)
-            if rank0:
-                print(f"iter {it:3d} val_loss {vloss:.4f}")
-                if metrics is not None:
-                    metrics.log(it, val_loss=vloss)
-        if getattr(args, "save_every", 0) and (it + 1) % args.save_every == 0:
-            from tiny_deepspeed_tpu.utils.checkpoint import save_checkpoint
-            save_checkpoint(args.save_dir, state, it + 1)
-            if rank0:
-                print(f"saved checkpoint at iter {it + 1}")
+                    metrics.log(
+                        it, loss=loss_f, step_s=it_dt,
+                        tokens_per_s=b * args.seq_len / max(it_dt, 1e-9),
+                        **telem.step_record(),
+                    )
+                    # anomaly-armed flight flush (slow step or non-finite
+                    # health): the last N steps' history lands as ONE
+                    # 'flight' record; syncs any per-layer matrices, so it
+                    # stays here at logging cadence, off the step hot path
+                    flight_reason = telem.maybe_flush_flight(metrics)
+                    if flight_reason is not None:
+                        print(f"iter {it:3d} flight record flushed "
+                              f"(reason: {flight_reason})")
+            else:
+                idx, tgt = loader.next()
+                batch = (jnp.asarray(idx), jnp.asarray(tgt))
+                host_prep_s += time.perf_counter() - it_t0
+                state, loss = engine.step(state, batch)
+                ran += 1
+                if rank0:
+                    # device->host sync (axon-safe barrier) only where the
+                    # value is consumed — other ranks run ahead and overlap
+                    # loader.next() with device compute (MetricsLogger.log is
+                    # rank-0 gated too)
+                    loss_f = float(loss)
+                    it_dt = time.perf_counter() - it_t0
+                    print(f"iter {it:3d} loss {loss_f:.4f}")
+                    if metrics is not None:
+                        metrics.log(it, loss=loss_f, step_s=it_dt,
+                                    tokens_per_s=b * args.seq_len
+                                    / max(it_dt, 1e-9))
+            if trace_started and it == start_iter + 4:
+                jax.profiler.stop_trace()
+                trace_started = False
+                if rank0:
+                    print(f"profiler trace written to {profile_dir}")
+            if eval_every and (it + 1) % eval_every == 0:
+                vals = []
+                for _ in range(args.eval_batches):
+                    vix, vtg = val_loader.next()
+                    vals.append(engine.eval_loss(
+                        state, (jnp.asarray(vix), jnp.asarray(vtg))
+                    ))
+                vloss = sum(float(v) for v in vals) / len(vals)
+                if rank0:
+                    print(f"iter {it:3d} val_loss {vloss:.4f}")
+                    if metrics is not None:
+                        metrics.log(it, val_loss=vloss)
+            if manager is not None:
+                manager.note_step()
+                saved = manager.maybe_save(
+                    state, it + 1, anomaly=flight_reason,
+                    data_meta=_data_meta(),
+                )
+                if saved is not None and rank0:
+                    print(f"saved checkpoint at iter {it + 1} ({saved})")
+                if guard.agreed():
+                    # preemption notice: drain ONE final committed
+                    # checkpoint from between steps (never mid-step — the
+                    # jitted step has donated the previous state's
+                    # buffers).  agreed(), not triggered: the flag is
+                    # rank-local and a drain only some hosts enter would
+                    # deadlock the final save's collective barriers
+                    # against the other hosts' next step
+                    drained = manager.maybe_save(
+                        state, it + 1, data_meta=_data_meta(), force=True,
+                    )
+                    manager.close()
+                    if rank0:
+                        print(f"preempted (signal "
+                              f"{guard.signum or 'on another host'}); "
+                              f"drained final checkpoint at iter {it + 1} "
+                              f"({drained or 'already committed'})")
+                    break
+    finally:
+        # drain the async writer and restore signal handlers even when
+        # the loop raised: a daemon writer thread killed mid-Orbax-write
+        # would silently drop a save already announced as kicked off.
+        # Capture the in-flight exception BEFORE calling close() — inside
+        # the except handler below, exc_info() would report the handled
+        # RuntimeError itself and a clean-exit save failure would be
+        # silently swallowed
+        import sys as _sys
+        _loop_exc = _sys.exc_info()[0]
+        if manager is not None:
+            try:
+                manager.close()
+            except RuntimeError:
+                if _loop_exc is None:
+                    raise  # do not mask the loop's own exception
+        if guard is not None:
+            guard.uninstall()
     if trace_started:  # run ended inside the trace window
         jax.profiler.stop_trace()
     elif profile_dir is not None and args.iters - start_iter <= 2 and rank0:

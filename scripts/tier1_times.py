@@ -1,0 +1,153 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Tier-1 runtime budgeting: where do the 870 seconds go?
+
+The tier-1 suite (`pytest -m 'not slow'`) runs under a hard timeout on
+small CI containers, and the budget is marginal — a timeout truncates the
+run and silently sheds coverage from whatever sorts last.  This script
+makes the spend visible so trimming is a measured decision, not a guess:
+
+    # run the suite yourself (records per-test durations):
+    python scripts/tier1_times.py --run [-- extra pytest args]
+
+    # or analyze an existing log from `pytest --durations=0 -vv`:
+    python scripts/tier1_times.py --from-log /tmp/t1.log
+
+Reports:
+  * slowest individual tests (the `--top` N),
+  * per-module totals (which FILE owns the budget),
+  * parametrization fan-out: parametrized test functions ranked by
+    (total seconds, case count) — the "most redundant parametrizations"
+    are the ones with many cases, high total time, and a cheap slowest
+    case; trimming candidates, to be cut only with the coverage argument
+    in hand.
+
+Exit code 1 (with `--budget S`) when the summed durations exceed the
+budget — a CI early-warning BEFORE the hard timeout starts truncating.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import subprocess
+import sys
+from collections import defaultdict
+
+# pytest --durations lines look like:
+#   12.34s call     tests/test_x.py::TestY::test_z[case]
+_DUR = re.compile(
+    r"^\s*(\d+\.\d+)s\s+(call|setup|teardown)\s+(\S+)\s*$"
+)
+
+
+def parse_durations(text: str):
+    """[(seconds, phase, nodeid)] from a pytest run with --durations."""
+    out = []
+    for line in text.splitlines():
+        m = _DUR.match(line)
+        if m:
+            out.append((float(m.group(1)), m.group(2), m.group(3)))
+    return out
+
+
+def split_nodeid(nodeid: str):
+    """(module, test function without parametrization, case or None)."""
+    module, _, rest = nodeid.partition("::")
+    case = None
+    fn = rest
+    if "[" in rest and rest.endswith("]"):
+        fn, _, case = rest[:-1].partition("[")
+    return module, fn, case
+
+
+def report(durations, top: int = 20, budget: float = 0.0) -> int:
+    if not durations:
+        print("no duration lines found — run pytest with --durations=0 "
+              "(or use --run)", file=sys.stderr)
+        return 2
+    calls = [(s, n) for s, phase, n in durations if phase == "call"]
+    total = sum(s for s, _, _ in durations)
+    call_total = sum(s for s, _ in calls)
+    print(f"recorded {len(calls)} test calls, {call_total:.1f}s in calls, "
+          f"{total:.1f}s with setup/teardown\n")
+
+    print(f"slowest {top} tests")
+    print("-" * 72)
+    for s, n in sorted(calls, reverse=True)[:top]:
+        print(f"{s:8.2f}s  {n}")
+
+    by_module = defaultdict(float)
+    n_module = defaultdict(int)
+    for s, n in calls:
+        m, _, _ = split_nodeid(n)
+        by_module[m] += s
+        n_module[m] += 1
+    print(f"\nper-module totals")
+    print("-" * 72)
+    for m, s in sorted(by_module.items(), key=lambda kv: -kv[1]):
+        print(f"{s:8.2f}s  {n_module[m]:4d} tests  {m}")
+
+    groups = defaultdict(list)
+    for s, n in calls:
+        m, fn, case = split_nodeid(n)
+        if case is not None:
+            groups[f"{m}::{fn}"].append((s, case))
+    print(f"\nparametrization fan-out (trim candidates: many cases, "
+          f"big total, cheap max)")
+    print("-" * 72)
+    ranked = sorted(
+        groups.items(), key=lambda kv: -sum(s for s, _ in kv[1])
+    )
+    for name, cases in ranked[:top]:
+        tot = sum(s for s, _ in cases)
+        mx = max(s for s, _ in cases)
+        print(f"{tot:8.2f}s  {len(cases):3d} cases  max {mx:6.2f}s  {name}")
+
+    if budget and total > budget:
+        print(f"\nBUDGET EXCEEDED: {total:.1f}s > {budget:.0f}s",
+              file=sys.stderr)
+        return 1
+    if budget:
+        print(f"\nwithin budget: {total:.1f}s <= {budget:.0f}s "
+              f"({100 * total / budget:.0f}%)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--run", action="store_true",
+                     help="run the tier-1 suite now with --durations=0 "
+                          "and analyze it (pass extra pytest args after "
+                          "--)")
+    src.add_argument("--from-log", metavar="FILE",
+                     help="analyze an existing pytest log that was "
+                          "produced with --durations=0")
+    p.add_argument("--top", type=int, default=20)
+    p.add_argument("--budget", type=float, default=0.0, metavar="S",
+                   help="exit 1 when summed durations exceed S seconds "
+                        "(tier-1 CI uses 870)")
+    p.add_argument("pytest_args", nargs="*",
+                   help="extra pytest args after -- (with --run)")
+    args = p.parse_args(argv)
+
+    if args.from_log:
+        with open(args.from_log, errors="replace") as f:
+            text = f.read()
+    else:
+        cmd = [
+            sys.executable, "-m", "pytest", "tests/", "-q", "-m",
+            "not slow", "--durations=0", "-p", "no:cacheprovider",
+            *args.pytest_args,
+        ]
+        print("+ " + " ".join(cmd), file=sys.stderr)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        text = proc.stdout + proc.stderr
+        sys.stderr.write(text[-2000:])
+    return report(parse_durations(text), top=args.top, budget=args.budget)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

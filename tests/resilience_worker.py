@@ -1,0 +1,95 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Worker for tests/test_resilience.py's killed-process restart test —
+NOT a pytest module.
+
+Run as:  python resilience_worker.py <mode> <ckpt_dir> [iters]
+
+Modes:
+  crash    — train, committing a checkpoint every 2 steps; at the LAST
+             save, SIGKILL ourselves between tmp-write and commit (via
+             the chaos io hook calling os.kill) — a real process death,
+             not an exception.
+  resume   — elastic_load the latest COMMITTED step, seek the data
+             stream to the saved sample offset, train to `iters`, print
+             one JSON line {"resumed": step, "losses": [...]}.
+  straight — train `iters` steps uninterrupted, print {"losses": [...]}.
+
+The parent asserts: the crash leaves an uncommitted partial on disk; the
+resume lands on the last committed step (not the torn one); and the
+resumed losses equal the straight run's (fp32 bit-exact on the CPU mesh).
+"""
+
+import json
+import os
+import sys
+
+mode, ckpt_dir = sys.argv[1], sys.argv[2]
+iters = int(sys.argv[3]) if len(sys.argv) > 3 else 6
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from tiny_deepspeed_tpu import AdamW, GPT2Model, GPTConfig, Zero2  # noqa: E402
+from tiny_deepspeed_tpu.data import TokenLoader  # noqa: E402
+from tiny_deepspeed_tpu.resilience import (  # noqa: E402
+    CheckpointManager, data_offset_batches, elastic_load,
+)
+from tiny_deepspeed_tpu.utils.checkpoint import set_io_hook  # noqa: E402
+
+B, T = 4, 32
+cfg = GPTConfig(block_size=T, vocab_size=128, n_layer=2, n_head=2,
+                n_embd=32, compute_dtype=jnp.float32)
+eng = Zero2(GPT2Model(cfg), AdamW(lr=1e-3))
+loader = TokenLoader(None, batch=B, seq=T, vocab_size=128, seed=7,
+                     force_numpy=True)
+
+start = 0
+resumed = None
+if mode == "resume":
+    state, info = elastic_load(ckpt_dir, eng)
+    resumed = info["resumed_step"]
+    start = resumed
+    loader.seek_samples(data_offset_batches(info, B) * B)
+else:
+    state = eng.init(jax.random.PRNGKey(0))
+
+mgr = CheckpointManager(ckpt_dir, every=2, engine=eng, async_save=False) \
+    if mode == "crash" else None
+
+if mode == "crash":
+    # a REAL kill between tmp-write and commit, at the final save only
+    kill_at_step = [iters]
+
+    def hook(phase, path, attempt):
+        if phase == "commit" and f"step_{kill_at_step[0]:08d}" in path:
+            os.kill(os.getpid(), 9)  # SIGKILL: no cleanup, no excepthook
+
+    set_io_hook(hook)
+
+losses = []
+for it in range(start, iters):
+    x, y = loader.next()
+    state, loss = eng.step(state, (jnp.asarray(x), jnp.asarray(y)))
+    losses.append(float(loss))
+    if mgr is not None:
+        mgr.maybe_save(state, it + 1, data_meta={
+            "samples_seen": loader.samples_seen, "global_batch": B,
+            "seed": 7,
+        })
+
+print(json.dumps({"mode": mode, "resumed": resumed, "losses": losses}),
+      flush=True)

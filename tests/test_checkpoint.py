@@ -27,6 +27,9 @@ def batch(i):
 
 
 class TestCheckpoint:
+    @pytest.mark.slow  # tier-1 budget: roundtrip + sharding-preserved
+    # restore is pinned quick by test_resume_training_bit_exact and
+    # test_resilience's elastic suite — full tier
     def test_save_restore_roundtrip_zero2(self, tmp_path):
         model = GPT2Model(TINY)
         eng = Zero2(model, AdamW(lr=1e-3))
@@ -77,6 +80,9 @@ class TestCheckpoint:
         with pytest.raises(FileNotFoundError):
             load_checkpoint(str(tmp_path))
 
+    @pytest.mark.slow  # tier-1 budget: three dropout-engine compiles;
+    # resume bit-exactness stays quick (test_resume_training_bit_exact)
+    # and the legacy dropout-base fill has its own quick test below
     def test_resume_preserves_dropout_stream(self, tmp_path):
         """The dropout base key rides the TrainState through a checkpoint:
         a restored state stepping on a FRESH engine (no init call) draws the

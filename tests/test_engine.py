@@ -93,6 +93,8 @@ class TestEngines:
         reason="environment-dependent: same marginal-numerics flatline as "
                "test_single_device_trains on this jaxlib 0.4.36 XLA-CPU "
                "build (loss 4.8566 vs 4.8554 after 5 steps)", strict=False)
+    @pytest.mark.slow  # tier-1 budget: SGD update math is unit-pinned
+    # in test_optim; the engine-level smoke runs in the full tier
     def test_sgd_engine(self, model):
         losses = run_steps(DDP(model, SGD(lr=1e-2, momentum=0.9)))
         assert losses[-1] < losses[0]
@@ -138,6 +140,9 @@ class TestEngines:
         ddp, z2 = temp_bytes(DDP), temp_bytes(Zero2)
         assert ddp - z2 > 0.5 * param_bytes, (ddp, z2, param_bytes)
 
+    @pytest.mark.slow  # tier-1 budget: accum parity stays quick via
+    # test_grad_accumulation_matches_large_batch + the sharded-
+    # accumulator pin; the zero2 one-shot identity — full tier
     def test_accum_matches_one_shot_zero2(self, model):
         """Sharded accumulation is exact: ZeRO-2 accum_steps=2 == one-shot."""
         e1 = Zero2(model, SGD(lr=1e-2))
@@ -226,6 +231,8 @@ class TestEngines:
             np.testing.assert_allclose(float(l_got), float(l_ref),
                                        rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # tier-1 budget: the cross-feature matrix keeps
+    # its llama-zero3-accum and zero3-fused-xent rows quick
     def test_cross_feature_bf16_state_zero1(self):
         """AdamW(state_dtype=bf16) under ZeRO-1: trains, and the moment
         slots really are stored bf16 AND sharded."""

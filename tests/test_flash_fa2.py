@@ -128,6 +128,8 @@ class TestFA2:
                 np.asarray(a), np.asarray(b.swapaxes(1, 2)),
                 rtol=1e-6, atol=1e-7, err_msg=f"d{name}")
 
+    @pytest.mark.slow  # tier-1 budget: the BTHD-vs-BHTD parity pin
+    # stays quick; the VMEM-budget fallback path runs in the full tier
     def test_bthd_fallback_past_vmem_budget(self, monkeypatch):
         """Past _AH_MAX_T_HD the entry transposes over to the standard
         kernels — same numbers, different plumbing."""

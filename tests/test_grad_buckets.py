@@ -113,7 +113,12 @@ class TestEngineGradBuckets:
         assert step_hlo(DDP, model, grad_comm="int8") \
             == step_hlo(DDP, model, grad_comm="int8", grad_buckets=1)
 
-    @pytest.mark.parametrize("mode", ["fp32", "int8", "fp8"])
+    # tier-1 budget (scripts/tier1_times.py): fp8 rides the identical
+    # schedule as int8 (only the codec differs, pinned at the primitive
+    # level in test_grad_comm) — its 20-step curve runs in the full tier
+    @pytest.mark.parametrize("mode", [
+        "fp32", "int8", pytest.param("fp8", marks=pytest.mark.slow),
+    ])
     def test_loss_parity_with_unbucketed(self, model, mode):
         """The acceptance bound: 20-step loss parity with the unbucketed
         path within 5% across grad_comm modes.  The fp32 buckets are the
@@ -133,6 +138,9 @@ class TestEngineGradBuckets:
             assert res.shape == (8, eng._bucket_layout["residual_len"])
             assert np.isfinite(res).all() and float(np.abs(res).max()) > 0
 
+    @pytest.mark.slow  # tier-1 budget: 4 engine compiles; the core wire
+    # pins (int8 >= 3x under fp32, in-scan placement) stay quick via
+    # test_bucket_collectives_issued_inside_backward_scan
     def test_wire_bytes_match_unbucketed_ledger(self, model):
         """Bucketed total wire tracks the monolithic ledger: fp32 exactly
         (the partitioner emits the same per-layer all-reduces), int8
@@ -178,6 +186,9 @@ class TestEngineGradBuckets:
         assert (b2["reduce_wire_bytes_in_loops"]
                 > 0.5 * b2["reduce_wire_bytes_total"])
 
+    @pytest.mark.slow  # tier-1 budget: the gauge value itself is pinned
+    # by the overlap_report assertions above; this adds the Telemetry
+    # plumbing check (3 engine compiles) — full tier
     def test_overlap_frac_telemetry_gauge(self, model):
         telem = Telemetry()
         eng = DDP(model, AdamW(lr=1e-3), grad_comm="int8", grad_buckets=2,
@@ -200,6 +211,9 @@ class TestEngineGradBuckets:
         telem0.capture_compiled(s0, batch)
         assert telem0.gauge("grad_comm_overlap_frac") == 0.0
 
+    @pytest.mark.slow  # tier-1 budget: 16-step curves + 2 ledger
+    # compiles; accum composition stays quick via grad_comm's
+    # test_accum_composes and the bucketed clip/scale compose test
     def test_accum_buckets_fire_once(self, model):
         """Buckets fire only on the final microbatch: the accumulated
         step's collective COUNT equals the single-microbatch bucketed

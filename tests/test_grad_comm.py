@@ -267,7 +267,12 @@ class TestEngineGradComm:
         assert e0._step.lower(s0, batch).as_text() \
             == e1._step.lower(s1, batch).as_text()
 
-    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    # tier-1 budget (scripts/tier1_times.py): the fp8 codec is pinned at
+    # the primitive level (TestQuantPrimitives) and rides the same
+    # schedule as int8 — its 20-step curve runs in the full tier
+    @pytest.mark.parametrize("mode", [
+        "int8", pytest.param("fp8", marks=pytest.mark.slow),
+    ])
     def test_convergence_parity_with_error_feedback(self, model, mode):
         base, _, _ = run_curve(model, steps=20)
         quant, state, _ = run_curve(model, steps=20, grad_comm=mode)
@@ -279,6 +284,8 @@ class TestEngineGradComm:
         assert res.shape[0] == 8 and np.isfinite(res).all()
         assert 0 < float(np.abs(res).max())
 
+    @pytest.mark.slow  # tier-1 budget: negative-space complement of the
+    # with-EF parity above (which stays quick); 40 steps of curves
     def test_convergence_without_error_feedback(self, model):
         base, _, _ = run_curve(model, steps=20)
         quant, state, _ = run_curve(
@@ -290,6 +297,9 @@ class TestEngineGradComm:
         assert max(rel) < 0.10
         assert quant[-1] < quant[0] - 0.1
 
+    @pytest.mark.slow  # tier-1 budget: 2-hop vs flat parity is pinned
+    # quick at the shard_map level (TestSchedule); the 20-step engine
+    # curve runs in the full tier
     def test_hierarchical_2hop_tracks_flat(self, model):
         flat, _, _ = run_curve(model, steps=10, grad_comm="int8")
         hier, _, eng = run_curve(model, steps=10, grad_comm="int8",
@@ -391,6 +401,9 @@ class TestEngineGradComm:
         with pytest.raises(ValueError, match="requires grad_comm"):
             DDP(model, AdamW(lr=1e-3), grad_comm_groups=4)
 
+    @pytest.mark.slow  # tier-1 budget: residual save/restore (kept,
+    # re-derived, zero-filled) is pinned quick in test_resilience's
+    # elastic suite; the same-topology roundtrip runs in the full tier
     def test_checkpoint_roundtrip_carries_residual(self, model, tmp_path):
         from tiny_deepspeed_tpu.utils.checkpoint import (
             load_checkpoint, save_checkpoint,
@@ -411,6 +424,9 @@ class TestEngineGradComm:
         state2, loss = eng.step(resumed, make_batch())
         assert np.isfinite(float(loss))
 
+    @pytest.mark.slow  # tier-1 budget: gauge names are drift-guarded
+    # in test_repo_hygiene; the wire numbers are pinned quick by
+    # test_ledger_gradient_wire_drops_4x
     def test_telemetry_gauges(self, model):
         telem = Telemetry()
         eng = DDP(model, AdamW(lr=1e-3), grad_comm="int8",

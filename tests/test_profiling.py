@@ -96,6 +96,9 @@ class TestCommReportVsCompiledHLO:
         assert not led["unresolved_groups"], led["unresolved_groups"]
         return comm_report(eng), led
 
+    @pytest.mark.slow  # tier-1 budget (scripts/tier1_times.py): the
+    # zero1/zero2/zero3 rows below pin the same ring model across
+    # harder layouts; the pure all-reduce row runs in the full tier
     def test_ddp_allreduce_matches(self):
         rep, led = self._ledger(DDP)
         # one variadic grad all-reduce; payload == param bytes (+ the f32
@@ -257,6 +260,8 @@ class TestCommReportVsCompiledHLO:
         n = led["count"].get("collective-permute", 0)
         assert 2 * ticks <= n <= 3 * ticks, (n, ticks)
 
+    @pytest.mark.slow  # tier-1 budget: fp8 gather wire is also pinned
+    # in test_zero3_gather_prefetch + the slow test_fp8_gather suite
     def test_zero3_fp8_gather_priced_from_stacked_dtypes(self):
         import dataclasses
         q = dataclasses.replace(self.CFG, gather_quant="fp8")

@@ -54,6 +54,33 @@ def test_gitignore_covers_bytecode():
         lines = {ln.strip() for ln in f}
     assert "__pycache__/" in lines
     assert "*.pyc" in lines
+    assert "*.so" in lines
+
+
+# the ONE shared object this repo may ever carry: the native dataloader
+# builds libtds_dataloader.so next to its source on first use
+# (data/loader.py), and some checkouts have shipped the prebuilt binary.
+# Nothing else compiled belongs in the tree.
+_ALLOWED_SO = {"tiny_deepspeed_tpu/native/libtds_dataloader.so"}
+
+
+def test_no_new_tracked_shared_objects():
+    """Pin that no build artifact beyond the allowlisted native-loader
+    binary ever gets tracked: .so files are machine-specific build
+    outputs (g++ rebuilds the loader from dataloader.cpp on first use),
+    and a second one appearing in `git ls-files` means someone committed
+    their local build."""
+    bad = [
+        p for p in _tracked_files()
+        if p.endswith((".so", ".dylib", ".a", ".o"))
+        and p not in _ALLOWED_SO
+    ]
+    assert not bad, (
+        f"tracked compiled artifacts beyond the allowlist: {bad} — "
+        f"`git rm --cached` them (.gitignore already excludes *.so; "
+        f"only {sorted(_ALLOWED_SO)} is tolerated for historical "
+        f"checkouts)"
+    )
 
 
 def test_gauge_names_documented_in_schema():

@@ -1,0 +1,830 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Resilience: atomic/async checkpointing, chaos-driven recovery, elastic
+(mesh-shape-changing) resume, preemption drain, straggler mitigation.
+
+Every recovery path is exercised by ACTUALLY breaking things through the
+chaos harness (tiny_deepspeed_tpu/resilience/chaos.py) — injected write
+failures, a writer killed between tmp-write and commit, NaN'd params,
+an in-process SIGTERM — not by mocking the failure's observers."""
+
+import dataclasses
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tiny_deepspeed_tpu import AdamW, GPTConfig, GPT2Model, Zero1, Zero2, \
+    Zero3
+from tiny_deepspeed_tpu.data import TokenLoader
+from tiny_deepspeed_tpu.parallel.mesh import make_mesh
+from tiny_deepspeed_tpu.resilience import (
+    Chaos, ChaosEngine, CheckpointManager, PreemptionGuard,
+    check_reshapeable, data_offset_batches, elastic_load,
+    rebalance_shares, ShardRebalancer,
+)
+from tiny_deepspeed_tpu.telemetry import Telemetry
+from tiny_deepspeed_tpu.utils.checkpoint import (
+    COMMIT_MARKER, CheckpointKilled, latest_step, list_steps,
+    load_checkpoint, read_meta, save_checkpoint, set_io_hook,
+)
+
+TINY = GPTConfig(
+    block_size=32, vocab_size=128, n_layer=2, n_head=2, n_embd=32,
+    compute_dtype=jnp.float32,
+)
+
+
+def batch(i, b=8):
+    k = jax.random.split(jax.random.PRNGKey(100 + i), 2)
+    return (jax.random.randint(k[0], (b, 32), 0, 128),
+            jax.random.randint(k[1], (b, 32), 0, 128))
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPT2Model(TINY)
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    return make_mesh(devices=jax.devices()[:4])
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(devices=jax.devices())
+
+
+@pytest.fixture(scope="module")
+def eng2_4(model, mesh4):
+    """Shared Zero2 engine on 4 devices (one XLA compile for the module)."""
+    return Zero2(model, AdamW(lr=1e-3), mesh=mesh4)
+
+
+@pytest.fixture(autouse=True)
+def _clean_io_hook():
+    yield
+    set_io_hook(None)  # no chaos leaks across tests
+
+
+# ---------------------------------------------------------------------------
+# atomic commit + partial-dir skipping (satellite: latest_step trusting any
+# step_* name used to crash restore)
+# ---------------------------------------------------------------------------
+
+class TestAtomicCommit:
+    def _tree(self):
+        return {"w": jnp.arange(8, dtype=jnp.float32), "n": jnp.int32(3)}
+
+    def test_commit_marker_and_meta(self, tmp_path):
+        d = str(tmp_path)
+        path = save_checkpoint(d, self._tree(), 5, meta={"step": 5})
+        assert os.path.exists(os.path.join(path, COMMIT_MARKER))
+        assert latest_step(d) == 5
+        assert read_meta(d, 5) == {"step": 5}
+        assert read_meta(d, 99) is None
+
+    def test_partial_dirs_skipped_not_crashed(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, self._tree(), 3)
+        # a crashed writer's leavings: empty dir with a LARGER step number
+        # (used to win max(steps) and crash the restore), plus junk
+        os.makedirs(os.path.join(d, "step_00000009"))
+        os.makedirs(os.path.join(d, "step_garbage"))
+        committed, skipped = list_steps(d)
+        assert committed == [3]
+        assert "step_00000009" in skipped and "step_garbage" in skipped
+        assert latest_step(d) == 3
+        tree = self._tree()
+        target = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+        )
+        restored = load_checkpoint(d, target=target)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(tree["w"]))
+
+    def test_only_partials_raises_naming_them(self, tmp_path):
+        d = str(tmp_path)
+        os.makedirs(os.path.join(d, "step_00000007"))
+        with pytest.raises(FileNotFoundError, match="step_00000007"):
+            load_checkpoint(d, target=None)
+        assert latest_step(d) is None
+
+    def test_explicit_uncommitted_step_refused(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, self._tree(), 1)
+        os.makedirs(os.path.join(d, "step_00000002"))
+        with pytest.raises(FileNotFoundError, match="not committed"):
+            load_checkpoint(d, target=None, step=2)
+
+    def test_rename_without_marker_is_uncommitted(self, tmp_path):
+        """The second crash window: dir renamed to its final name but the
+        writer died before the marker — both our marker and Orbax's
+        finalize artifact must be absent for the skip to trigger."""
+        d = str(tmp_path)
+        path = save_checkpoint(d, self._tree(), 4)
+        os.remove(os.path.join(path, COMMIT_MARKER))
+        meta = os.path.join(path, "_CHECKPOINT_METADATA")
+        if os.path.exists(meta):
+            os.remove(meta)
+        assert latest_step(d) is None
+
+
+# ---------------------------------------------------------------------------
+# bounded retry + backoff (satellite), chaos write failures
+# ---------------------------------------------------------------------------
+
+class TestRetryBackoff:
+    def test_transient_failures_retried_and_counted(self, tmp_path):
+        telem = Telemetry(flight_steps=0)
+        chaos = Chaos(ckpt_write_failures=2)
+        with chaos:
+            path = save_checkpoint(
+                str(tmp_path), {"w": jnp.zeros(4)}, 1,
+                retries=3, backoff=0.01, telemetry=telem,
+            )
+        assert os.path.exists(os.path.join(path, COMMIT_MARKER))
+        assert telem.counters["checkpoint_retries"].value == 2
+        assert [r["fault"] for r in chaos.injected] \
+            == ["ckpt_write_failure"] * 2
+
+    def test_exhausted_retries_name_path_and_attempts(self, tmp_path):
+        chaos = Chaos(ckpt_write_failures=99)
+        with chaos, pytest.raises(RuntimeError) as ei:
+            save_checkpoint(str(tmp_path), {"w": jnp.zeros(4)}, 7,
+                            retries=1, backoff=0.01)
+        msg = str(ei.value)
+        assert "step_00000007" in msg and "2 attempt" in msg
+        assert latest_step(str(tmp_path)) is None
+
+    def test_uncommitted_final_dir_cleaned_on_each_attempt(self, tmp_path):
+        """An attempt that dies between os.rename and the COMMITTED
+        marker leaves a non-empty uncommitted dir at the FINAL path; the
+        next retry must clean it again or its own rename fails with
+        ENOTEMPTY and a one-shot transient error exhausts every retry."""
+        d = str(tmp_path)
+        path = os.path.join(d, "step_00000003")
+
+        def hook(phase, p, attempt):
+            if phase == "write" and attempt == 0:
+                os.makedirs(path, exist_ok=True)
+                with open(os.path.join(path, "junk"), "w") as f:
+                    f.write("partial payload, no marker")
+                raise OSError("transient blip")
+
+        set_io_hook(hook)
+        out = save_checkpoint(d, {"w": jnp.zeros(4)}, 3,
+                              retries=2, backoff=0.01)
+        assert out == path and latest_step(d) == 3
+        assert not os.path.exists(os.path.join(path, "junk"))
+
+
+# ---------------------------------------------------------------------------
+# crash mid-save (satellite): killed between tmp-write and commit; the next
+# restore lands on the previous good step and training continues bit-exact
+# ---------------------------------------------------------------------------
+
+class TestCrashMidSave:
+    def test_kill_between_tmp_write_and_commit(self, tmp_path, eng2_4):
+        d = str(tmp_path)
+        s = eng2_4.init(jax.random.PRNGKey(0))
+        s, _ = eng2_4.step(s, batch(0))
+        save_checkpoint(d, s, 1)
+
+        # uninterrupted reference from the committed point (the step
+        # donates its input buffers, so each trajectory restores its own)
+        ref = load_checkpoint(d, eng2_4)
+        for i in range(1, 3):
+            ref, loss_ref = eng2_4.step(ref, batch(i))
+
+        s = load_checkpoint(d, eng2_4)
+        s, _ = eng2_4.step(s, batch(1))
+        chaos = Chaos().install()
+        chaos.kill_next_commit()
+        with pytest.raises(CheckpointKilled):
+            save_checkpoint(d, s, 2)
+        chaos.uninstall()
+        # the payload was fully written, but never committed: only the
+        # dot-prefixed tmp dir exists and the resume chain still ends at 1
+        assert latest_step(d) == 1
+        assert any(n.startswith(".tmp_step_") for n in os.listdir(d))
+
+        restored = load_checkpoint(d, eng2_4)
+        for i in range(1, 3):
+            restored, loss_res = eng2_4.step(restored, batch(i))
+        assert float(loss_res) == float(loss_ref)
+
+
+# ---------------------------------------------------------------------------
+# async save + adaptive cadence (CheckpointManager)
+# ---------------------------------------------------------------------------
+
+class _SlowWrites:
+    """io hook that stalls the write phase — keeps the async writer thread
+    observably in flight without depending on disk speed."""
+
+    def __init__(self, delay_s):
+        self.delay_s = delay_s
+
+    def __call__(self, phase, path, attempt):
+        if phase == "write":
+            time.sleep(self.delay_s)
+
+
+class TestCheckpointManager:
+    def test_async_save_snapshots_before_donation(self, tmp_path, eng2_4):
+        """The async writer must persist the state AS OF the save call:
+        the engine's jitted step donates the old state's buffers, so the
+        manager snapshots to host before kicking the thread.  Training
+        steps taken while the write is in flight must not change what
+        lands on disk."""
+        d = str(tmp_path)
+        s = eng2_4.init(jax.random.PRNGKey(0))
+        s, _ = eng2_4.step(s, batch(0))
+        w_at_save = np.asarray(s.params["wte"]).copy()
+        set_io_hook(_SlowWrites(0.2))
+        with CheckpointManager(d, engine=eng2_4) as mgr:
+            mgr.save(s, 1)
+            # step twice while the write is in flight (donates s's buffers)
+            for i in range(1, 3):
+                s, _ = eng2_4.step(s, batch(i))
+                mgr.note_step()
+            assert mgr.overlap_steps >= 1  # steps hidden behind I/O
+        set_io_hook(None)
+        restored = load_checkpoint(d, eng2_4, step=1)
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["wte"]), w_at_save
+        )
+        meta = read_meta(d, 1)
+        assert meta["elastic"]["mesh"]["n_devices"] == 4
+
+    def test_background_failure_surfaces_on_next_call(self, tmp_path):
+        chaos = Chaos(ckpt_write_failures=99).install()
+        mgr = CheckpointManager(str(tmp_path), retries=0, backoff=0.01)
+        mgr.save({"w": jnp.zeros(4)}, 1)
+        with pytest.raises(RuntimeError, match="background checkpoint"):
+            mgr.wait()
+        chaos.uninstall()
+
+    def test_interval_and_anomaly_cadence(self, tmp_path):
+        telem = Telemetry(flight_steps=8)
+        mgr = CheckpointManager(str(tmp_path), every=4, telemetry=telem,
+                                async_save=False)
+        tree = {"w": jnp.zeros(4)}
+        assert mgr.maybe_save(tree, 1) is None
+        assert mgr.maybe_save(tree, 4) == "interval"
+        # flight-recorder anomaly (slow step): checkpoint immediately,
+        # off-interval — and edge-triggered, not once per later step
+        telem.flight_pending = "slow_step"
+        assert mgr.maybe_save(tree, 6) == "anomaly:slow_step"
+        assert mgr.maybe_save(tree, 7) is None
+        assert latest_step(str(tmp_path)) == 6
+        assert telem.counters["checkpoint_saves"].value == 2
+        assert telem.gauges["checkpoint_last_step"] == 6
+
+    def test_force_drain_not_fooled_by_failed_async_save(self, tmp_path):
+        """last_saved_step records an ENQUEUE, not a commit: when the
+        in-flight interval save fails, the SIGTERM drain at the same
+        step must still produce a committed checkpoint (warning about
+        the earlier failure) instead of trusting the dedup and exiting
+        with nothing on disk."""
+        d = str(tmp_path)
+        chaos = Chaos(ckpt_write_failures=1).install()
+        mgr = CheckpointManager(d, every=1, retries=0, backoff=0.01)
+        tree = {"w": jnp.zeros(4)}
+        assert mgr.maybe_save(tree, 1) == "interval"  # enqueued; will fail
+        mgr._thread.join()  # let the failure land (stays pending)
+        with pytest.warns(UserWarning, match="background checkpoint"):
+            assert mgr.maybe_save(tree, 1, force=True) == "final"
+        chaos.uninstall()
+        mgr.close()
+        assert latest_step(d) == 1
+
+    def test_anomaly_latch_consumed_and_retriggers(self, tmp_path):
+        """The manager CONSUMES telemetry.flight_pending when no flight
+        flusher ran first (no metrics logger): clearing the latch re-arms
+        the registry's edge trigger, so a SECOND anomaly episode fires a
+        second checkpoint instead of being swallowed forever."""
+        telem = Telemetry(flight_steps=8)
+        mgr = CheckpointManager(str(tmp_path), telemetry=telem,
+                                async_save=False)
+        tree = {"w": jnp.zeros(4)}
+        telem.flight_pending = "slow_step"
+        assert mgr.maybe_save(tree, 3) == "anomaly:slow_step"
+        assert telem.flight_pending is None
+        assert mgr.maybe_save(tree, 4) is None
+        telem.flight_pending = "slow_step"  # new episode, same reason
+        assert mgr.maybe_save(tree, 9) == "anomaly:slow_step"
+        assert latest_step(str(tmp_path)) == 9
+
+    def test_interval_save_of_nonfinite_state_stays_out_of_chain(
+            self, tmp_path):
+        """A NaN episode outlives its one edge-triggered anomaly: the
+        NEXT interval (or final-drain) save must consult health and route
+        the still-poisoned state to postmortem, not the resume chain."""
+        d = str(tmp_path)
+        telem = Telemetry(flight_steps=8)
+        mgr = CheckpointManager(d, every=2, telemetry=telem,
+                                async_save=False)
+        tree = {"w": jnp.zeros(4)}
+        assert mgr.maybe_save(tree, 2) == "interval"
+        telem._last_health = {"loss": float("nan"), "nonfinite_grads": 1}
+        bad = {"w": jnp.full(4, jnp.nan)}
+        # the reason says postmortem — the caller's "saved checkpoint"
+        # log must not promise a restore point latest_step can't see
+        assert mgr.maybe_save(bad, 4) == "postmortem:interval"
+        assert latest_step(d) == 2                       # chain unpoisoned
+        assert latest_step(os.path.join(d, "postmortem")) == 4
+        assert mgr.maybe_save(bad, 5, force=True) == "postmortem:final"
+        assert latest_step(d) == 2
+        assert latest_step(os.path.join(d, "postmortem")) == 5
+        # drain coinciding with an already-saved postmortem step must not
+        # crash on the committed dir — it skips (nothing new to secure)
+        assert mgr.maybe_save(bad, 5, force=True) is None
+
+    def test_postmortem_replayed_after_resume_skips_committed_dir(
+            self, tmp_path):
+        """A resumed deterministic run replays the same NaN step, and the
+        duplicate-postmortem latch is process-local: a FRESH manager must
+        see the previous process's committed postmortem ON DISK and skip,
+        instead of dying on save_checkpoint's already-committed check
+        (an opaque background-save failure in the async case)."""
+        d = str(tmp_path)
+        telem = Telemetry(flight_steps=8)
+        mgr = CheckpointManager(d, telemetry=telem, async_save=False)
+        bad = {"w": jnp.full(4, jnp.nan)}
+        telem.flight_pending = "nonfinite"
+        assert mgr.maybe_save(bad, 3) == "anomaly:nonfinite"
+        # "restart": a new process = a new manager, no in-memory latch
+        telem2 = Telemetry(flight_steps=8)
+        mgr2 = CheckpointManager(d, telemetry=telem2, async_save=False)
+        telem2.flight_pending = "nonfinite"
+        with pytest.warns(UserWarning, match="already committed"):
+            assert mgr2.maybe_save(bad, 3) is None
+        assert latest_step(os.path.join(d, "postmortem")) == 3
+
+    def test_nonfinite_anomaly_goes_to_postmortem(self, tmp_path):
+        """A NaN state is preserved for debugging but must never enter
+        the resume chain — latest_step would otherwise restore a NaN."""
+        d = str(tmp_path)
+        telem = Telemetry(flight_steps=8)
+        mgr = CheckpointManager(d, every=2, telemetry=telem,
+                                async_save=False)
+        tree = {"w": jnp.zeros(4)}
+        mgr.maybe_save(tree, 2)
+        telem.flight_pending = "nonfinite"
+        bad = {"w": jnp.full(4, jnp.nan)}
+        assert mgr.maybe_save(bad, 3) == "anomaly:nonfinite"
+        assert latest_step(d) == 2                       # chain unpoisoned
+        assert latest_step(os.path.join(d, "postmortem")) == 3
+        assert telem.counters["checkpoint_postmortems"].value == 1
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM drains one final committed checkpoint
+# ---------------------------------------------------------------------------
+
+class TestPreemption:
+    def test_guard_flags_and_restores_handler(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with PreemptionGuard() as g:
+            assert g.active and not g.triggered
+            signal.raise_signal(signal.SIGTERM)
+            assert g.triggered and g.signum == signal.SIGTERM
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_agreed_ors_rank_local_flags_across_hosts(self):
+        """The loop drains on `agreed()`, never the raw flag: hosts see
+        the preemption notice at different iterations, and a final save
+        only some hosts enter deadlocks its collective barriers against
+        the others' next step — one host's SIGTERM must drain EVERY host
+        at the same loop point, and no-signal-anywhere must drain none."""
+        with PreemptionGuard() as g:
+            # remote-only signal: local flag False, another host's True
+            assert g.agreed(lambda x: np.array([bool(x), True])) is True
+            assert g.agreed(lambda x: np.array([bool(x), False])) is False
+            signal.raise_signal(signal.SIGTERM)
+            assert g.agreed(lambda x: np.array([bool(x), False])) is True
+            assert g.agreed() is True  # single-process: local flag, no sync
+
+    def test_sigterm_drain_and_exact_data_offset(self, tmp_path, eng2_4):
+        """The acceptance pin: chaos injects SIGTERM mid-run; the loop
+        drains a final committed checkpoint carrying the exact global
+        sample offset; the resumed run consumes the SAME remaining
+        batches as an uninterrupted run — none skipped, none repeated."""
+        d = str(tmp_path)
+        b, total_iters = 8, 6
+
+        def stream():
+            return TokenLoader(None, batch=b, seq=32, vocab_size=128,
+                               seed=5, force_numpy=True)
+
+        # uninterrupted reference: 6 steps, recording each batch's ids
+        loader = stream()
+        ref_batches = []
+        s = eng2_4.init(jax.random.PRNGKey(0))
+        for _ in range(total_iters):
+            x, y = loader.next()
+            ref_batches.append(x.copy())
+            s, loss_ref = eng2_4.step(s, (jnp.asarray(x), jnp.asarray(y)))
+
+        # chaos run: SIGTERM raised at step 3 -> guard drains at loop end
+        chaos = Chaos(sigterm_step=3)
+        ce = ChaosEngine(eng2_4, chaos)
+        loader = stream()
+        s = eng2_4.init(jax.random.PRNGKey(0))
+        with PreemptionGuard() as guard, \
+                CheckpointManager(d, engine=eng2_4) as mgr:
+            for it in range(total_iters):
+                x, y = loader.next()
+                np.testing.assert_array_equal(x, ref_batches[it])
+                s, _ = ce.step(s, (jnp.asarray(x), jnp.asarray(y)))
+                if guard.triggered:
+                    mgr.maybe_save(
+                        s, it + 1, force=True,
+                        data_meta={"samples_seen": loader.samples_seen,
+                                   "global_batch": b, "seed": 5},
+                    )
+                    break
+        stopped_at = it + 1
+        assert stopped_at == 4  # sigterm at 0-based step 3
+        assert latest_step(d) == stopped_at
+        assert chaos.injected[0]["fault"] == "sigterm"
+
+        # restart: fresh process's view — elastic_load + sample-offset seek
+        s2, info = elastic_load(d, eng2_4)
+        assert info["resumed_step"] == stopped_at
+        assert not info["elastic"]
+        off = data_offset_batches(info, b)
+        assert off == stopped_at  # data-offset pinned
+        loader = stream()
+        loader.seek_samples(off * b)
+        for it in range(stopped_at, total_iters):
+            x, y = loader.next()
+            np.testing.assert_array_equal(x, ref_batches[it])  # no skip
+            s2, loss_res = eng2_4.step(s2, (jnp.asarray(x), jnp.asarray(y)))
+        assert float(loss_res) == float(loss_ref)  # fp32 bit-exact
+
+
+# ---------------------------------------------------------------------------
+# elastic (mesh-shape-changing) resume — the tentpole acceptance pin
+# ---------------------------------------------------------------------------
+
+class TestElasticResume:
+    @pytest.mark.parametrize("engine_cls", [Zero1, Zero2, Zero3])
+    def test_grow_4_to_8_devices_loss_parity(self, engine_cls, model,
+                                             mesh4, mesh8, tmp_path):
+        """Train K steps on 4 devices, checkpoint, restore onto 8,
+        continue K — the final loss matches an uninterrupted 2K-step run
+        (fp32 deterministic path: < 1e-4)."""
+        d = str(tmp_path)
+        K = 3
+        eng_n = engine_cls(model, AdamW(lr=1e-3), mesh=mesh4)
+        s = eng_n.init(jax.random.PRNGKey(0))
+        for i in range(K):
+            s, _ = eng_n.step(s, batch(i))
+        mgr = CheckpointManager(d, engine=eng_n, async_save=False)
+        mgr.save(s, K, data_meta={"samples_seen": K * 8,
+                                  "global_batch": 8, "seed": 0})
+
+        eng_m = engine_cls(model, AdamW(lr=1e-3), mesh=mesh8)
+        s2, info = elastic_load(d, eng_m)
+        assert info["elastic"] and info["old_mesh"]["n_devices"] == 4
+        assert info["new_mesh"]["n_devices"] == 8
+        assert data_offset_batches(info, 8) == K
+        # optimizer state landed in the NEW mesh's ZeRO sharding and the
+        # step counter carried over
+        assert int(s2.opt_state["step"]) == K
+        m = s2.opt_state["state"]["h.mlp.fc.w"]["m"]
+        assert np.prod(m.sharding.shard_shape(m.shape)) * 8 \
+            == np.prod(m.shape)
+        for i in range(K, 2 * K):
+            s2, loss_res = eng_m.step(s2, batch(i))
+
+        ref = eng_m.init(jax.random.PRNGKey(0))
+        for i in range(2 * K):
+            ref, loss_ref = eng_m.step(ref, batch(i))
+        assert abs(float(loss_res) - float(loss_ref)) < 1e-4
+
+    def test_shrink_8_to_4_devices(self, model, mesh4, mesh8, tmp_path):
+        """The preemption direction: the slice came back SMALLER."""
+        d = str(tmp_path)
+        eng_n = Zero3(model, AdamW(lr=1e-3), mesh=mesh8)
+        s = eng_n.init(jax.random.PRNGKey(0))
+        for i in range(2):
+            s, _ = eng_n.step(s, batch(i))
+        CheckpointManager(d, engine=eng_n, async_save=False).save(s, 2)
+
+        eng_m = Zero3(model, AdamW(lr=1e-3), mesh=mesh4)
+        s2, info = elastic_load(d, eng_m)
+        assert info["elastic"] and info["moved_params"] > 0
+        for i in range(2, 4):
+            s2, loss_res = eng_m.step(s2, batch(i))
+        ref = eng_m.init(jax.random.PRNGKey(0))
+        for i in range(4):
+            ref, loss_ref = eng_m.step(ref, batch(i))
+        assert abs(float(loss_res) - float(loss_ref)) < 1e-4
+
+    def test_refusal_names_both_meshes(self, eng2_4):
+        """Configs that pin state to mesh positions refuse loudly, with
+        the old AND new shapes in the message."""
+        saved = {
+            "engine": "Zero2", "stage": 2, "n_shard": 4,
+            "mesh": {"axes": {"data": 4, "pipe": 2}, "n_devices": 8,
+                     "n_processes": 1},
+            "residual_shape": None,
+        }
+        with pytest.raises(ValueError) as ei:
+            check_reshapeable(saved, eng2_4)
+        msg = str(ei.value)
+        assert "pipe" in msg and "data=4" in msg and "pipe=2" in msg
+        assert "data=4 (4 devices)" in msg  # the new mesh, named too
+
+    def test_same_mesh_is_not_elastic(self, eng2_4, tmp_path):
+        s = eng2_4.init(jax.random.PRNGKey(1))
+        CheckpointManager(str(tmp_path), engine=eng2_4,
+                          async_save=False).save(s, 1)
+        _, info = elastic_load(str(tmp_path), eng2_4)
+        assert not info["elastic"]
+        assert info["residual_action"] == "kept"
+
+    def test_legacy_checkpoint_without_meta_warns(self, eng2_4, tmp_path):
+        s = eng2_4.init(jax.random.PRNGKey(1))
+        save_checkpoint(str(tmp_path), s, 1)  # no meta sidecar
+        with pytest.warns(UserWarning, match="no elastic descriptor"):
+            _, info = elastic_load(str(tmp_path), eng2_4)
+        assert info["old_mesh"] is None
+
+    def test_residual_rederived_on_topology_change(self, model, mesh4,
+                                                   mesh8, tmp_path):
+        """grad_comm error-feedback residual is (n_dev, pad)-shaped: a
+        topology change re-derives it (zeroed) instead of crashing the
+        restore or silently mis-sharding it."""
+        d = str(tmp_path)
+        eng_n = Zero2(model, AdamW(lr=1e-3), mesh=mesh4, grad_comm="int8")
+        s = eng_n.init(jax.random.PRNGKey(0))
+        s, _ = eng_n.step(s, batch(0))
+        assert s.grad_residual.shape[0] == 4
+        CheckpointManager(d, engine=eng_n, async_save=False).save(s, 1)
+
+        eng_m = Zero2(model, AdamW(lr=1e-3), mesh=mesh8, grad_comm="int8")
+        with pytest.warns(UserWarning, match="re-derived"):
+            s2, info = elastic_load(d, eng_m)
+        assert info["residual_action"] == "rederived"
+        assert s2.grad_residual.shape[0] == 8
+        assert float(jnp.sum(jnp.abs(s2.grad_residual))) == 0.0
+        s2, loss = eng_m.step(s2, batch(1))
+        assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# data offsets: exact resume across batch-size changes (indexed loader)
+# ---------------------------------------------------------------------------
+
+class TestDataOffsets:
+    def test_indexed_stream_is_batch_size_invariant(self):
+        """Sample g of the indexed stream is the same array no matter how
+        the stream is batched — the property that makes a mesh change
+        (new global batch) resume with nothing skipped or repeated."""
+        a = TokenLoader(None, batch=4, seq=16, vocab_size=64, seed=3,
+                        indexed=True)
+        xs_a = np.concatenate([a.next()[0] for _ in range(6)])  # 24 samples
+        b = TokenLoader(None, batch=8, seq=16, vocab_size=64, seed=3,
+                        indexed=True)
+        xs_b = np.concatenate([b.next()[0] for _ in range(3)])  # 24 samples
+        np.testing.assert_array_equal(xs_a, xs_b)
+
+    def test_indexed_seek_any_offset(self):
+        a = TokenLoader(None, batch=4, seq=16, vocab_size=64, seed=3,
+                        indexed=True)
+        for _ in range(3):
+            a.next()
+        nxt = a.next()[0]  # samples 12..15
+        b = TokenLoader(None, batch=4, seq=16, vocab_size=64, seed=3,
+                        indexed=True)
+        b.seek_samples(12)
+        np.testing.assert_array_equal(b.next()[0], nxt)
+        # arbitrary (not batch-aligned) offsets are the indexed mode's point
+        c = TokenLoader(None, batch=4, seq=16, vocab_size=64, seed=3,
+                        indexed=True)
+        c.seek_samples(14)
+        np.testing.assert_array_equal(c.next()[0][:2], nxt[2:])
+
+    def test_indexed_corpus_mode(self, tmp_path):
+        path = str(tmp_path / "toks.bin")
+        (np.arange(50_000) % 500).astype(np.uint16).tofile(path)
+        a = TokenLoader(path, batch=2, seq=16, seed=1, indexed=True)
+        x, y = a.next()
+        np.testing.assert_array_equal(x[:, 1:], y[:, :-1])
+        b = TokenLoader(path, batch=2, seq=16, seed=1, indexed=True)
+        np.testing.assert_array_equal(b.next()[0], x)
+
+    def test_batch_loader_seek_matches_replay(self):
+        a = TokenLoader(None, batch=4, seq=16, vocab_size=64, seed=9,
+                        force_numpy=True)
+        for _ in range(3):
+            a.next()
+        b = TokenLoader(None, batch=4, seq=16, vocab_size=64, seed=9,
+                        force_numpy=True)
+        b.seek_samples(12)
+        assert b.samples_seen == 12
+        np.testing.assert_array_equal(b.next()[0], a.next()[0])
+
+    def test_native_loader_seek_replays(self):
+        a = TokenLoader(None, batch=4, seq=16, vocab_size=64, seed=9)
+        if a.backend != "native":
+            pytest.skip("native loader unavailable")
+        for _ in range(2):
+            a.next()
+        b = TokenLoader(None, batch=4, seq=16, vocab_size=64, seed=9)
+        b.seek_samples(8)
+        np.testing.assert_array_equal(b.next()[0], a.next()[0])
+
+    def test_seek_guards(self):
+        a = TokenLoader(None, batch=4, seq=16, vocab_size=64, seed=9,
+                        force_numpy=True)
+        a.next()
+        with pytest.raises(ValueError, match="backwards"):
+            a.seek_samples(0)
+        with pytest.raises(ValueError, match="batch-aligned"):
+            a.seek_samples(6)
+
+    def test_data_offset_batches(self):
+        info = {"data": {"samples_seen": 24, "global_batch": 8}}
+        assert data_offset_batches(info, 8) == 3
+        assert data_offset_batches(info, 4) == 6  # elastic: new batch size
+        with pytest.raises(ValueError, match="not divisible"):
+            data_offset_batches(info, 7)
+        assert data_offset_batches({}, 8) is None  # legacy: no data meta
+
+
+# ---------------------------------------------------------------------------
+# chaos: NaN injection drives the detector + postmortem + recovery e2e
+# ---------------------------------------------------------------------------
+
+class TestChaosNanRecovery:
+    def test_deterministic_schedule(self):
+        a = Chaos(seed=11, nan_prob=0.5)
+        b = Chaos(seed=11, nan_prob=0.5)
+        pat_a = [a.fires("nan", s) for s in range(32)]
+        pat_b = [b.fires("nan", s) for s in range(32)]
+        assert pat_a == pat_b and any(pat_a) and not all(pat_a)
+        assert [c.fires("nan", s) for c in [Chaos(seed=12, nan_prob=0.5)]
+                for s in range(32)] != pat_a
+
+    def test_nan_injection_detected_and_recovered(self, model, mesh4,
+                                                  tmp_path):
+        """The full loop: chaos NaNs a param -> the next step's health
+        goes non-finite -> flight recorder arms -> manager snapshots a
+        POSTMORTEM (resume chain untouched) -> recovery reloads the last
+        good committed step and training continues finite."""
+        d = str(tmp_path)
+        telem = Telemetry(flight_steps=8)
+        eng = Zero2(model, AdamW(lr=1e-3), mesh=mesh4, telemetry=telem)
+        chaos = Chaos(nan_steps=(2,))
+        ce = ChaosEngine(eng, chaos)
+        mgr = CheckpointManager(d, every=2, engine=eng, telemetry=telem,
+                                async_save=False)
+        s = eng.init(jax.random.PRNGKey(0))
+        for it in range(4):
+            with telem.step(index=it):
+                s, _ = ce.step(s, batch(it))
+            mgr.maybe_save(s, it + 1,
+                           data_meta={"samples_seen": (it + 1) * 8,
+                                      "global_batch": 8, "seed": 0})
+            if telem.flight_pending == "nonfinite" \
+                    or mgr.last_reason == "anomaly:nonfinite":
+                break
+        # injected after step index 2 -> detected on step index 3
+        assert mgr.last_reason == "anomaly:nonfinite"
+        assert telem.counters["anomalies_nonfinite"].value == 1
+        assert latest_step(d) == 2                     # last GOOD commit
+        assert latest_step(os.path.join(d, "postmortem")) is not None
+
+        good, info = elastic_load(d, eng)
+        assert info["resumed_step"] == 2
+        for leaf in jax.tree.leaves(good.params):
+            assert bool(jnp.all(jnp.isfinite(leaf)))
+        good, loss = eng.step(good, batch(2))
+        assert np.isfinite(float(loss))
+
+    def test_fault_records_validate_against_schema(self):
+        from tiny_deepspeed_tpu.telemetry.schema import validate_record
+        chaos = Chaos(nan_steps=(1,), sigterm_step=None)
+        chaos.fires("nan", 1)
+        chaos.record("ckpt_kill", path="/x", attempts=0)
+
+        class Sink:
+            recs = []
+
+            def log_meta(self, kind, **fields):
+                self.recs.append({"kind": kind, "ts": 0.0, **fields})
+
+        sink = Sink()
+        chaos.log_faults(sink)
+        assert chaos.injected == []
+        assert len(sink.recs) == 2
+        for rec in sink.recs:
+            assert validate_record(rec) == []
+
+
+# ---------------------------------------------------------------------------
+# killed-process restart: a REAL SIGKILL mid-commit, then a fresh process
+# resumes from the last committed step (heavy: 3 subprocess JAX inits)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_real_process_kill_and_restart(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "resilience_worker.py")
+    d = str(tmp_path)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    def run(mode, iters):
+        return subprocess.run(
+            [sys.executable, worker, mode, d, str(iters)],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+
+    crash = run("crash", 4)
+    assert crash.returncode == -signal.SIGKILL, (crash.returncode,
+                                                 crash.stderr[-500:])
+    # died between tmp-write and commit of step 4: partial on disk,
+    # resume chain ends at the last COMMITTED step
+    assert any(n.startswith(".tmp_step_00000004") for n in os.listdir(d))
+    assert latest_step(d) == 2
+
+    resumed = run("resume", 6)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    rec = json.loads(resumed.stdout.strip().splitlines()[-1])
+    assert rec["resumed"] == 2
+
+    straight = run("straight", 6)
+    assert straight.returncode == 0, straight.stderr[-2000:]
+    ref = json.loads(straight.stdout.strip().splitlines()[-1])
+    np.testing.assert_allclose(rec["losses"], ref["losses"][2:], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# straggler mitigation: rebalance per-host data shards
+# ---------------------------------------------------------------------------
+
+class TestStragglerRebalance:
+    def test_shares_exact_sum_and_monotonic(self):
+        shares = rebalance_shares([0.1, 0.1, 0.3, 0.1], 64)
+        assert sum(shares) == 64
+        assert shares[2] < min(shares[0], shares[1], shares[3])
+        assert all(s >= 1 for s in shares)
+        # balanced hosts split evenly
+        assert rebalance_shares([0.2] * 4, 64) == [16] * 4
+
+    def test_min_share_and_guards(self):
+        shares = rebalance_shares([0.001, 10.0], 8, min_share=2)
+        assert shares[1] == 2 and sum(shares) == 8
+        with pytest.raises(ValueError, match="min_share"):
+            rebalance_shares([1.0, 1.0], 1, min_share=1)
+
+    def test_hysteresis_fires_after_patience(self):
+        telem = Telemetry(flight_steps=0)
+        reb = ShardRebalancer(global_batch=32, threshold=0.3, patience=3,
+                              telemetry=telem)
+        skew = [0.1, 0.1, 0.1, 0.4]        # frac = (0.4-0.1)/0.4 = 0.75
+        assert reb.observe(skew) is None
+        assert reb.observe([0.1] * 4) is None   # streak broken
+        assert reb.observe(skew) is None
+        assert reb.observe(skew) is None
+        shares = reb.observe(skew)              # 3rd consecutive -> fire
+        assert shares is not None and sum(shares) == 32
+        assert shares[3] < shares[0]
+        assert telem.counters["straggler_rebalances"].value == 1
+        assert reb.observe(skew) is None        # re-armed
+
+    def test_wired_to_straggler_attribution(self):
+        """End-to-end with the PR-5 gauges: a chaos-delayed host shows up
+        in sample_stragglers' gathered walls, and the rebalancer acts on
+        exactly that record's step_s_by_host."""
+        chaos = Chaos(delay_steps=(0, 1, 2), delay_s=0.05)
+        telem = Telemetry(flight_steps=0)
+        walls = [0.01, 0.01, 0.01
+                 + (chaos.delay_s if chaos.fires("delay", 0) else 0.0)]
+        rec = telem.sample_stragglers(
+            step_s=walls[0], allgather=lambda _: walls,
+            quantity="host_prep_s",
+        )
+        assert rec["slowest_host"] == 2
+        assert telem.gauges["straggler_frac"] > 0.5
+        reb = ShardRebalancer(global_batch=24, threshold=0.3, patience=1)
+        shares = reb.observe(rec["step_s_by_host"])
+        assert shares is not None and sum(shares) == 24 and \
+            shares[2] < shares[0]

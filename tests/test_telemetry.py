@@ -68,7 +68,12 @@ class TestHealthMetrics:
     the sharded partial sums, so every stage must report the same
     numbers)."""
 
-    @pytest.mark.parametrize("eng_cls", [DDP, Zero2, Zero3])
+    # tier-1 budget (scripts/tier1_times.py): DDP's replicated grads are
+    # the degenerate case of the cross-shard psum the Zero2/Zero3 rows
+    # pin — it runs in the full tier
+    @pytest.mark.parametrize("eng_cls", [
+        pytest.param(DDP, marks=pytest.mark.slow), Zero2, Zero3,
+    ])
     def test_matches_host_recompute(self, model, eng_cls):
         telem = Telemetry()
         eng = eng_cls(model, AdamW(lr=1e-3), telemetry=telem)
@@ -134,6 +139,8 @@ class TestTelemetryOffIsFree:
         text_none = eng_none._step.lower(state2, batch).as_text()
         assert text_default == text_none
 
+    @pytest.mark.slow  # tier-1 budget: telemetry-off byte-identity is
+    # the quick primary pin; this ledger corollary runs in the full tier
     def test_off_vs_on_collective_ledger(self, model, ddp_off, ddp_on):
         """The health norms may add only scalar-sized reductions: the
         telemetry-on step's collective ledger stays within 1 KB of the
@@ -150,6 +157,8 @@ class TestTelemetryOffIsFree:
         assert abs(led_on["total_wire_bytes"]
                    - led_off["total_wire_bytes"]) <= 1024
 
+    @pytest.mark.slow  # tier-1 budget: subsumed by the byte-identity
+    # pin (identical programs have identical signatures) — full tier
     def test_step_returns_same_signature(self, model, ddp_off, ddp_on):
         eng_on, telem = ddp_on
         batch = make_batch(1)
@@ -410,6 +419,9 @@ class TestSchemaAndReport:
 
 
 class TestExampleEndToEnd:
+    @pytest.mark.slow  # tier-1 budget: an example SUBPROCESS e2e like
+    # the (slow) test_examples suite; report_run schema/render pins
+    # stay quick above
     def test_ddp_example_renders_report(self, tmp_path):
         """Acceptance: scripts/report_run.py renders a markdown run report
         from a REAL examples/ddp run's JSONL, including measured

@@ -142,6 +142,9 @@ class TestModelIntegration:
         np.testing.assert_allclose(float(got), float(ref), rtol=1e-5,
                                    atol=1e-6)
 
+    @pytest.mark.slow  # tier-1 budget: head-loss parity + direct
+    # gradient checks stay quick; the full train-step smoke runs in
+    # the full tier
     def test_train_step_grads_flow(self):
         """One SingleDevice step with the pallas head trains (finite,
         loss decreases over a few steps at a hot lr)."""

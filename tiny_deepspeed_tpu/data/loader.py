@@ -34,6 +34,10 @@ _lib = None
 _lib_lock = threading.Lock()
 _build_error: Optional[str] = None
 
+# rng-key tag separating the indexed per-sample stream from the per-batch
+# stream (both key off (seed, ...)); a constant, never a knob
+_IDX_TAG = 0x1D5A
+
 
 def _load_native():
     global _lib, _build_error
@@ -79,11 +83,21 @@ class TokenLoader:
     def __init__(self, path: Optional[str], batch: int, seq: int,
                  vocab_size: int = 50304, seed: int = 0,
                  prefetch: int = 4, threads: int = 2,
-                 force_numpy: bool = False):
+                 force_numpy: bool = False, indexed: bool = False):
         self.batch, self.seq, self.vocab = batch, seq, vocab_size
         self.seed = seed
+        # indexed mode (elastic resume, resilience/elastic.py): sample g
+        # of the GLOBAL stream is drawn from rng((seed, _IDX_TAG, g)) —
+        # deterministic per sample index regardless of how samples are
+        # batched, so a run resumed with a DIFFERENT global batch size
+        # continues at an exact sample offset with nothing skipped or
+        # repeated.  Numpy path only (the native pipeline's stream is
+        # per-batch); seek_samples accepts any offset.
+        self.indexed = bool(indexed)
+        self.samples_seen = 0
         self._handle = None
-        self._lib = None if force_numpy else _load_native()
+        self._lib = (None if force_numpy or indexed
+                     else _load_native())
         self.backend = "numpy"
 
         if self._lib is not None:
@@ -122,26 +136,94 @@ class TokenLoader:
             )
             if rc != 0:
                 raise RuntimeError("loader stopped")
+            self.samples_seen += self.batch
             return x, y
-        return self._numpy_next()
+        out = self._numpy_next()
+        self.samples_seen += self.batch
+        return out
 
     def _numpy_next(self):
+        if self.indexed:
+            return self._indexed_next()
         rng = np.random.default_rng((self.seed, self._rng_counter))
         self._rng_counter += 1
         if self._tokens is not None:
             usable = self._tokens.size - self.seq - 1
             starts = rng.integers(0, usable, size=self.batch)
-            x = np.stack([
-                self._tokens[s:s + self.seq] for s in starts
-            ]).astype(np.int32)
-            y = np.stack([
-                self._tokens[s + 1:s + self.seq + 1] for s in starts
-            ]).astype(np.int32)
-            return x, y
+            return self._crops(starts)
         seqs = rng.integers(
             0, self.vocab, size=(self.batch, self.seq + 1), dtype=np.int32
         )
         return seqs[:, :-1], seqs[:, 1:]
+
+    def _indexed_next(self):
+        """One batch in indexed mode: samples [samples_seen,
+        samples_seen + batch) of the global per-sample stream.
+
+        Cost note: one default_rng construction (SeedSequence hash) per
+        sample per batch, ~20-30us each — a permanent host-side cost of
+        ~b*25us/step once a run switches to the indexed stream.  A
+        counter-based generator (one Philox jumped to the sample offset,
+        drawing the batch vectorized) would remove it, but bounded
+        integer draws consume a value-dependent number of words
+        (rejection sampling), so fixed per-sample counter strides need a
+        raw-word + modulo scheme — a distribution change not worth it at
+        example scale."""
+        base = self.samples_seen
+        if self._tokens is not None:
+            usable = self._tokens.size - self.seq - 1
+            starts = [
+                int(np.random.default_rng(
+                    (self.seed, _IDX_TAG, base + j)
+                ).integers(0, usable))
+                for j in range(self.batch)
+            ]
+            return self._crops(starts)
+        seqs = np.stack([
+            np.random.default_rng((self.seed, _IDX_TAG, base + j)).integers(
+                0, self.vocab, size=self.seq + 1, dtype=np.int32
+            )
+            for j in range(self.batch)
+        ])
+        return seqs[:, :-1], seqs[:, 1:]
+
+    def _crops(self, starts):
+        x = np.stack([
+            self._tokens[s:s + self.seq] for s in starts
+        ]).astype(np.int32)
+        y = np.stack([
+            self._tokens[s + 1:s + self.seq + 1] for s in starts
+        ]).astype(np.int32)
+        return x, y
+
+    def seek_samples(self, n: int) -> None:
+        """Fast-forward the stream to global sample offset `n` (the
+        elastic-resume data contract: nothing skipped, nothing repeated).
+        Indexed mode accepts any offset directly; the per-batch backends
+        (native / plain numpy) require batch alignment — the numpy path
+        jumps its counter, the native pipeline replays batches."""
+        n = int(n)
+        if n < self.samples_seen:
+            raise ValueError(
+                f"cannot seek backwards (at sample {self.samples_seen}, "
+                f"asked for {n}); build a fresh loader"
+            )
+        if self.indexed:
+            self.samples_seen = n
+            return
+        if (n - self.samples_seen) % self.batch:
+            raise ValueError(
+                f"seek to sample {n} is not batch-aligned for "
+                f"batch={self.batch} (at {self.samples_seen}); use "
+                f"TokenLoader(indexed=True) for arbitrary offsets"
+            )
+        if self._handle is not None:
+            while self.samples_seen < n:
+                self.next()
+            return
+        skip = (n - self.samples_seen) // self.batch
+        self._rng_counter += skip
+        self.samples_seen = n
 
     def __iter__(self):
         return self

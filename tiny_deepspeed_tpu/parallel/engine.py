@@ -1825,6 +1825,54 @@ class ZeroEngine:
         idx, targets = batch
         return self._eval(state.params, idx, targets)
 
+    def state_target(self) -> "TrainState":
+        """The restore target for this engine's TrainState: a pytree of
+        ShapeDtypeStruct(+NamedSharding) describing where every leaf
+        should land — params replicated or ZeRO-3-sharded, optimizer
+        state ZeRO-sharded, scaler/dropout/residual as configured.
+        Consumed by utils.checkpoint.load_checkpoint and the elastic
+        resume path (resilience/elastic.py), which swaps individual
+        sub-targets when the checkpoint was written on a different
+        topology."""
+        shapes = jax.eval_shape(
+            lambda: self.init(jax.random.PRNGKey(0))
+        )
+        shardings = TrainState(
+            params=self._param_shardings,
+            opt_state=self._opt_shardings,
+            scaler=self._scaler_shardings,
+            dropout_base=self._dropout_shardings,
+            grad_residual=getattr(self, "_residual_shardings", None),
+        )
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            shapes,
+            shardings,
+        )
+
+    def elastic_descriptor(self) -> Dict[str, Any]:
+        """JSON-safe identity of this engine's topology-dependent layout,
+        persisted in the checkpoint meta sidecar so a resume onto a
+        DIFFERENT mesh can decide what must be re-derived and what must
+        be refused (resilience/elastic.py::check_reshapeable).  Every
+        field is derivable state, not configuration — params/optimizer
+        global shapes are topology-independent (Orbax reshards them on
+        read); the residual shape and the non-data axes are not."""
+        from .mesh import mesh_descriptor
+        return {
+            "engine": type(self).__name__,
+            "stage": int(self.stage),
+            "mesh": mesh_descriptor(self.mesh),
+            "n_shard": int(self.n_shard),
+            "accum_steps": int(self.accum_steps),
+            "residual_shape": (
+                list(self._residual_shape)
+                if getattr(self, "_residual_shape", None) is not None
+                else None
+            ),
+        }
+
     def gather_params(self, state):
         """Fully-replicated copy of the params — the bridge from a sharded
         TrainState to single-program consumers like `model.generate()`

@@ -220,6 +220,28 @@ class ParallelContext:
         )
 
 
+def mesh_descriptor(mesh: Mesh) -> dict:
+    """JSON-safe identity of a mesh's shape: axis names/sizes, device and
+    host counts.  Persisted in checkpoint meta sidecars so an elastic
+    resume can compare the checkpoint's topology with the current one
+    (resilience/elastic.py) and name BOTH in its refusal message."""
+    return {
+        "axes": {str(k): int(v) for k, v in mesh.shape.items()},
+        "n_devices": int(mesh.devices.size),
+        "n_processes": int(jax.process_count()),
+    }
+
+
+def describe_mesh(desc: Optional[dict]) -> str:
+    """Human-readable one-liner for a mesh_descriptor (or unknown)."""
+    if not desc:
+        return "<unknown mesh (no checkpoint meta)>"
+    axes = "×".join(
+        f"{k}={v}" for k, v in desc.get("axes", {}).items()
+    ) or "?"
+    return f"{axes} ({desc.get('n_devices', '?')} devices)"
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -115,6 +115,30 @@ def partition_sizes(table: Dict[str, int], named_tensors, num_parts: int):
     return sizes
 
 
+def repartition_delta(
+    named_tensors,
+    old_parts: int,
+    new_parts: int,
+    evenness_priority: float = 0.0,
+) -> Dict[str, Tuple[int, int]]:
+    """{name: (old_rank, new_rank)} for tensors whose greedy owner CHANGES
+    when the rank count moves from `old_parts` to `new_parts`.
+
+    The elastic-resume path (resilience/elastic.py) re-derives the ZeRO
+    partition tables for the new topology by simply rebuilding the engine
+    on the new mesh; this function reports how the reference-parity
+    ownership table shifted in the process, so a resume record can say
+    how much state physically moved (Orbax reshards the actual arrays on
+    read — this is the accounting, not the mechanism)."""
+    old = partition_tensors(named_tensors, old_parts, evenness_priority)
+    new = partition_tensors(named_tensors, new_parts, evenness_priority)
+    return {
+        name: (old[name], new[name])
+        for name in old
+        if old[name] != new[name]
+    }
+
+
 def materialize_owned(named_shapes, table: Dict[str, int], devices=None,
                       init=None):
     """Physically place each WHOLE tensor on its owner rank's device — the

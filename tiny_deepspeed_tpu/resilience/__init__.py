@@ -1,0 +1,53 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Elastic, preemption-tolerant training.
+
+Real TPU fleets run on preemptible capacity: a slice can vanish mid-step,
+come back smaller, and the run is expected to continue — the reference
+(and this repo until now) treated fault tolerance as a documented
+non-goal.  This package makes the three missing pieces first-class:
+
+  * `checkpoint` — CheckpointManager: async atomic saves that overlap
+    Orbax I/O with the next training steps, adaptive cadence (checkpoint
+    immediately when the telemetry anomaly detector fires, postmortem
+    snapshots on non-finite health), bounded retry, and PreemptionGuard —
+    a SIGTERM handler that drains one final committed checkpoint before
+    the process dies.  Rides the atomic tmp-dir + rename + COMMITTED
+    marker contract in utils/checkpoint.py.
+  * `elastic` — restore a checkpoint saved on N devices onto an M-device
+    mesh: the engine re-derives its ZeRO partition tables and
+    NamedShardings for the new topology, Orbax reshards the global
+    arrays on read, topology-shaped leaves (the quantized-grad-comm
+    error-feedback residual) are re-derived, and the data loader resumes
+    at the exact global sample offset.  Configurations that cannot
+    reshape (pipeline stage slabs, MoE expert placement, TP/SP layouts)
+    are refused loudly with both mesh shapes in the message.
+  * `chaos` — deterministic, seed-driven fault injection: NaN'd
+    parameters (poisoning the next step's gradients), delayed hosts
+    (exercising the straggler gauges), checkpoint write failures and
+    simulated writer kills between tmp-write and commit, and an injected
+    SIGTERM — so every recovery path is tested by actually breaking
+    things, not by mocks.
+  * `straggler` — the first straggler MITIGATION: rebalance per-host
+    data-shard sizes when the PR-5 `straggler_frac` gauge stays high.
+"""
+
+from .checkpoint import CheckpointManager, PreemptionGuard
+from .elastic import (
+    check_reshapeable, data_offset_batches, elastic_load,
+)
+from .chaos import Chaos, ChaosEngine
+from .straggler import ShardRebalancer, rebalance_shares
+
+__all__ = [
+    "CheckpointManager",
+    "PreemptionGuard",
+    "elastic_load",
+    "check_reshapeable",
+    "data_offset_batches",
+    "Chaos",
+    "ChaosEngine",
+    "ShardRebalancer",
+    "rebalance_shares",
+]

@@ -1,0 +1,187 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Fault-injection harness: deterministic, seed-driven chaos.
+
+Every recovery path in this package is tested by actually breaking
+things — a NaN really reaches the gradients, a checkpoint writer really
+dies between tmp-write and commit, a host really stalls — not by mocking
+the failure's observers.  Faults fire deterministically: either from an
+explicit step set, or from a per-(seed, kind, step) counter-mode RNG, so
+a failing chaos test replays bit-identically from its seed.
+
+    chaos = Chaos(seed=7, nan_steps=(3,), ckpt_write_failures=2)
+    chaos.install()                       # checkpoint I/O hook
+    eng = ChaosEngine(engine, chaos)      # step-level faults
+    ...
+    chaos.uninstall()
+
+Fault kinds:
+  * "nan"    — poison one parameter with NaN AFTER the injected step:
+               the next forward/backward produces non-finite loss and
+               gradients everywhere (exactly how real overflow spreads),
+               driving the telemetry non-finite detector end-to-end.
+  * "delay"  — sleep `delay_s` before the step (a straggling host;
+               exercises the straggler gauges and the rebalancer).
+  * "sigterm"— raise SIGTERM in-process at the injected step (the
+               preemption notice; exercises PreemptionGuard's drain).
+  * checkpoint I/O — `ckpt_write_failures` transient OSErrors on save
+               attempts (exercises retry/backoff) and `kill_next_commit`
+               a CheckpointKilled between tmp-write and commit
+               (exercises the uncommitted-dir skip on restore).
+"""
+
+from __future__ import annotations
+
+import signal
+import time
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..utils.checkpoint import CheckpointKilled, set_io_hook
+
+_KIND_CODE = {"nan": 1, "delay": 2, "sigterm": 3}
+
+
+class Chaos:
+    """Deterministic fault schedule + checkpoint I/O fault hook."""
+
+    def __init__(self, seed: int = 0, *,
+                 nan_steps: Iterable[int] = (),
+                 nan_prob: float = 0.0,
+                 delay_steps: Iterable[int] = (),
+                 delay_prob: float = 0.0,
+                 delay_s: float = 0.25,
+                 sigterm_step: Optional[int] = None,
+                 ckpt_write_failures: int = 0):
+        self.seed = int(seed)
+        self.delay_s = float(delay_s)
+        self._steps = {
+            "nan": frozenset(int(s) for s in nan_steps),
+            "delay": frozenset(int(s) for s in delay_steps),
+            "sigterm": frozenset(
+                () if sigterm_step is None else (int(sigterm_step),)
+            ),
+        }
+        self._prob = {"nan": float(nan_prob), "delay": float(delay_prob),
+                      "sigterm": 0.0}
+        self._write_fails_left = int(ckpt_write_failures)
+        self._kill_commit = False
+        self.injected: List[Dict] = []  # JSON-safe fault log
+
+    # -- schedule ----------------------------------------------------------
+
+    def fires(self, kind: str, step: int) -> bool:
+        """True when fault `kind` fires at `step` — explicit step set
+        first, then the seeded probability draw (counter-mode: the
+        decision for (seed, kind, step) never depends on call order)."""
+        hit = step in self._steps[kind]
+        p = self._prob[kind]
+        if not hit and p > 0.0:
+            rng = np.random.default_rng(
+                (self.seed, _KIND_CODE[kind], int(step))
+            )
+            hit = bool(rng.random() < p)
+        if hit:
+            self.record(kind, at_step=step)
+        return hit
+
+    def record(self, fault: str, **fields) -> Dict:
+        rec = {"fault": fault, **fields}
+        self.injected.append(rec)
+        return rec
+
+    def log_faults(self, logger) -> None:
+        """Write every injected fault as a `kind="fault"` JSONL record
+        (telemetry/schema.py) and clear the log."""
+        for rec in self.injected:
+            logger.log_meta(kind="fault", **rec)
+        self.injected = []
+
+    # -- checkpoint I/O faults ---------------------------------------------
+
+    def fail_next_writes(self, n: int) -> None:
+        """Arm `n` transient write failures (each save ATTEMPT consumes
+        one; the retry loop in utils/checkpoint.py rides them out)."""
+        self._write_fails_left = int(n)
+
+    def kill_next_commit(self) -> None:
+        """Arm ONE simulated writer death between tmp-write and commit:
+        the next save raises CheckpointKilled after the payload is fully
+        written but before the rename+marker — on disk it looks exactly
+        like a SIGKILL'd process."""
+        self._kill_commit = True
+
+    def checkpoint_hook(self, phase: str, path: str, attempt: int) -> None:
+        if phase == "write" and self._write_fails_left > 0:
+            self._write_fails_left -= 1
+            self.record("ckpt_write_failure", path=path, attempts=attempt)
+            raise OSError(
+                f"chaos: injected transient checkpoint write failure "
+                f"(attempt {attempt})"
+            )
+        if phase == "commit" and self._kill_commit:
+            self._kill_commit = False
+            self.record("ckpt_kill", path=path, attempts=attempt)
+            raise CheckpointKilled(
+                "chaos: writer killed between tmp-write and commit"
+            )
+
+    def install(self) -> "Chaos":
+        set_io_hook(self.checkpoint_hook)
+        return self
+
+    def uninstall(self) -> None:
+        set_io_hook(None)
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+def poison_params(state):
+    """NaN the [0, 0, ...] element of the first float parameter (sorted
+    name order): the next step's forward sees a non-finite weight, so its
+    loss AND every gradient leaf go non-finite — the honest propagation
+    path, not a synthetic health vector."""
+    import dataclasses
+    for name in sorted(state.params):
+        leaf = state.params[name]
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            new = dict(state.params)
+            new[name] = leaf.at[(0,) * leaf.ndim].set(jnp.nan)
+            return dataclasses.replace(state, params=new)
+    raise ValueError("no float parameter leaf to poison")
+
+
+class ChaosEngine:
+    """Engine proxy that injects step-level faults: delays before the
+    step, NaN poisoning after it, SIGTERM at it.  Tracks its own step
+    counter (0-based, counting `step()` calls); everything else
+    delegates to the wrapped engine."""
+
+    def __init__(self, engine, chaos: Chaos):
+        self.engine = engine
+        self.chaos = chaos
+        self.steps_run = 0
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+    def step(self, state, batch):
+        it = self.steps_run
+        self.steps_run += 1
+        if self.chaos.fires("delay", it):
+            time.sleep(self.chaos.delay_s)
+        if self.chaos.fires("sigterm", it):
+            signal.raise_signal(signal.SIGTERM)
+        state, loss = self.engine.step(state, batch)
+        if self.chaos.fires("nan", it):
+            state = poison_params(state)
+        return state, loss

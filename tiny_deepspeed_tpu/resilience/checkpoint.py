@@ -1,0 +1,415 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Preemption-safe checkpoint cadence: async atomic saves, anomaly-driven
+cadence, and the SIGTERM drain.
+
+`CheckpointManager` owns a run's checkpoint lifecycle:
+
+  * async save — `save()` snapshots the TrainState to host memory
+    synchronously (cheap: one D2H copy, and REQUIRED for correctness —
+    the engine's jitted step donates the state buffers, so a background
+    thread must never read device arrays the next step may invalidate),
+    then a writer thread runs the Orbax serialization + atomic commit
+    while training continues.  One save in flight at a time; a second
+    request waits for the first (backpressure, never a dropped commit).
+    `overlap_steps` counts training steps that ran while a save was in
+    flight — the measured "steps hidden behind I/O" number (PROFILE.md).
+  * adaptive cadence — `maybe_save(state, step, anomaly=...)` saves on
+    the fixed interval AND immediately when the telemetry anomaly
+    detector fires (the PR-5 flight-recorder signal: step-time spike or
+    non-finite health).  A non-finite anomaly routes to a POSTMORTEM
+    checkpoint under `<dir>/postmortem/` — preserved for debugging but
+    invisible to `latest_step`, so the resume chain can never land on a
+    NaN state.
+  * preemption drain — pair with `PreemptionGuard`: the signal handler
+    only sets a flag; the training loop observes it between steps and
+    calls `maybe_save(..., force=True)` + `close()`, draining one final
+    COMMITTED checkpoint before exit (a handler that saved inline could
+    fire mid-step with the state donated).
+
+Multi-host note: the async host-snapshot path requires fully-addressable
+arrays (single-process meshes); on a multi-host run `save()` falls back
+to a synchronous device-array save, where Orbax writes each host's
+shards (utils/checkpoint.py handles the cross-host commit barrier).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+import warnings
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+
+from ..utils.checkpoint import _is_committed, _step_dir, save_checkpoint
+
+
+class PreemptionGuard:
+    """SIGTERM/preemption flag: installs handlers that record the signal
+    and return — the training loop polls `triggered` between steps and
+    drains a final checkpoint on its own schedule.  Restores the previous
+    handlers on `uninstall()` / context exit.  Inert (with a warning)
+    when not on the main thread, where CPython forbids signal handlers.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.triggered = False
+        self.signum: Optional[int] = None
+        self._orig: Dict[int, Any] = {}
+        self.active = False
+        try:
+            for s in signals:
+                self._orig[s] = signal.signal(s, self._handler)
+            self.active = True
+        except ValueError:  # not the main thread
+            warnings.warn(
+                "PreemptionGuard inactive: signal handlers can only be "
+                "installed from the main thread",
+                stacklevel=2,
+            )
+
+    def _handler(self, signum, frame):
+        self.triggered = True
+        self.signum = signum
+
+    def agreed(self, allgather=None) -> bool:
+        """Host-agreed drain decision.  `triggered` is RANK-LOCAL —
+        hosts can observe the preemption notice at different iterations,
+        and a final save only some hosts enter mismatches
+        save_checkpoint's collective barriers against the others' next
+        training step (the same hazard that disables multi-host anomaly
+        cadence in `CheckpointManager.maybe_save`).  On multi-host this
+        ORs the flag across hosts, so every host calling at the same
+        loop point drains at the same step; single-process returns the
+        local flag directly.  `allgather` is injectable for tests
+        (defaults to multihost_utils.process_allgather)."""
+        if jax.process_count() == 1 and allgather is None:
+            return self.triggered
+        if allgather is None:
+            from jax.experimental import multihost_utils
+            allgather = multihost_utils.process_allgather
+        flags = allgather(np.asarray(self.triggered, dtype=np.bool_))
+        return bool(np.any(flags))
+
+    def uninstall(self) -> None:
+        for s, h in self._orig.items():
+            signal.signal(s, h)
+        self._orig = {}
+        self.active = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+class CheckpointManager:
+    """Async atomic checkpointing with interval + anomaly cadence.
+
+        mgr = CheckpointManager(dir, every=50, engine=engine,
+                                telemetry=telem)
+        for it in range(iters):
+            state, loss = engine.step(state, batch)
+            mgr.note_step()
+            mgr.maybe_save(state, it + 1, anomaly=flush_reason,
+                           data_meta={...})
+        mgr.close()
+
+    Telemetry wiring (when a Telemetry is passed): counters
+    `checkpoint_saves` / `checkpoint_postmortems` (+ `checkpoint_retries`
+    from utils/checkpoint.py), gauges `checkpoint_save_s` /
+    `checkpoint_last_step` / `checkpoint_overlap_steps`.
+    """
+
+    def __init__(self, directory: str, *, every: int = 0, engine=None,
+                 telemetry=None, retries: int = 3, backoff: float = 0.5,
+                 async_save: bool = True):
+        self.directory = directory
+        self.every = int(every)
+        self.engine = engine
+        self.telemetry = telemetry
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self.async_save = bool(async_save)
+        self.saves = 0
+        self.postmortems = 0
+        self.overlap_steps = 0          # steps run while a save was in flight
+        self.last_saved_step: Optional[int] = None
+        self.last_reason: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+        self._pending_exc: Optional[BaseException] = None
+        self._mh_anomaly_warned = False
+        self._last_postmortem_step: Optional[int] = None
+
+    # -- cadence -----------------------------------------------------------
+
+    def maybe_save(self, state, step: int, *, anomaly: Optional[str] = None,
+                   data_meta: Optional[dict] = None,
+                   force: bool = False) -> Optional[str]:
+        """Save when due; returns the reason ("interval" / "anomaly:<r>" /
+        "final") or None.  `anomaly` is the flight-flush reason the caller
+        observed this step (examples/common.py passes
+        `telem.maybe_flush_flight`'s return); when no caller-side signal
+        exists the manager CONSUMES `telemetry.flight_pending` itself —
+        a non-None latch here means no flusher ran before us this step
+        (the examples flush first when a metrics logger is on), and
+        clearing it re-arms the registry's edge trigger for the next
+        anomaly episode.  Non-finite anomalies snapshot to the postmortem
+        dir — the state is already poisoned, so committing it into the
+        resume chain would make `latest_step` restore a NaN; the same
+        guard checks `telemetry.last_health` on EVERY due save, because a
+        NaN episode outlives its one edge-triggered anomaly and the next
+        interval/final save must not commit the poisoned state either."""
+        failed_prev = False
+        if force:
+            # drain priority: a PREVIOUS background failure must not
+            # abort the final save — warn, remember not to trust that
+            # save as a commit below, and drain a fresh one
+            try:
+                self._raise_pending()
+            except RuntimeError as e:
+                failed_prev = True
+                warnings.warn(
+                    f"previous background checkpoint save failed "
+                    f"({e.__cause__!r}); draining a fresh final save",
+                    stacklevel=2,
+                )
+        else:
+            self._raise_pending()
+        single = jax.process_count() == 1
+        if anomaly is not None and not single:
+            # the anomaly signal is RANK-LOCAL (telemetry instruments
+            # rank 0 only) but save_checkpoint is a collective with
+            # multihost barriers: a save only one host enters deadlocks
+            # against the others' next step.  Multi-host anomaly cadence
+            # needs a host-agreed signal first — until then, interval /
+            # final cadence only (deterministic on every host).
+            if not self._mh_anomaly_warned:
+                self._mh_anomaly_warned = True
+                warnings.warn(
+                    "anomaly-driven checkpoint cadence is disabled on "
+                    "multi-host runs (rank-local signal cannot drive a "
+                    "collective save); interval/final cadence still "
+                    "applies", stacklevel=2,
+                )
+            anomaly = None
+        if anomaly is None and self.telemetry is not None and single:
+            pending = getattr(self.telemetry, "flight_pending", None)
+            if pending is not None:
+                anomaly = pending
+                self.telemetry.flight_pending = None
+        reason = None
+        if force:
+            reason = "final"
+        elif anomaly is not None:
+            reason = f"anomaly:{anomaly}"
+        elif self.every and step % self.every == 0:
+            reason = "interval"
+        if reason is None:
+            return None
+        postmortem = anomaly is not None and "nonfinite" in str(anomaly)
+        if not postmortem and self.telemetry is not None and single:
+            # rank-local for the same reason as above: on multi-host the
+            # hosts would route the collective save to different paths
+            h = getattr(self.telemetry, "last_health", None)
+            if h is not None and (
+                h.get("nonfinite_grads")
+                or not np.isfinite(h.get("loss", 0.0))
+            ):
+                postmortem = True
+                # the returned reason must not sound resumable — this
+                # save is invisible to latest_step, and the caller's
+                # "saved checkpoint" log would otherwise promise a
+                # restore point that does not exist
+                reason = f"postmortem:{reason}"
+        if postmortem and self._last_postmortem_step == step:
+            # anomaly + interval/drain coinciding on a poisoned step:
+            # the postmortem dir is already committed — a second save of
+            # the same step would die on the already-committed check
+            return None
+        if postmortem:
+            # the dedup above is process-local, but a resumed
+            # deterministic run replays the same trajectory and re-hits
+            # the same NaN step — the PREVIOUS process's postmortem for
+            # this step is already committed on disk, and save_checkpoint
+            # would die on its already-committed check (in the writer
+            # thread, surfacing as an opaque background-save failure)
+            pm = _step_dir(os.path.join(self.directory, "postmortem"), step)
+            if _is_committed(pm):
+                self._last_postmortem_step = step
+                warnings.warn(
+                    f"postmortem for step {step} already committed at "
+                    f"{pm} (anomaly replayed after resume); skipping the "
+                    f"duplicate save", stacklevel=2,
+                )
+                return None
+        if not postmortem and self.last_saved_step == step:
+            if not force:
+                return None  # interval+anomaly coinciding: one commit is enough
+            # preemption drain: last_saved_step records an ASYNC save at
+            # enqueue time, not commit time — skipping on an in-flight
+            # (possibly failing) save would drain nothing and lose the
+            # state that was in hand.  Only a confirmed commit skips.
+            if not failed_prev:
+                try:
+                    self.wait()
+                    return None
+                except RuntimeError:
+                    pass  # the enqueued save failed: drain a fresh one
+        if force and self._thread is not None:
+            # drain priority once more: a still-IN-FLIGHT failing save
+            # (for an earlier step) would otherwise surface inside
+            # save()'s backpressure wait() and abort the final save
+            try:
+                self.wait()
+            except RuntimeError as e:
+                warnings.warn(
+                    f"in-flight background checkpoint save failed "
+                    f"({e.__cause__!r}); draining the final save anyway",
+                    stacklevel=2,
+                )
+        self.save(state, step, data_meta=data_meta,
+                  extra_meta={"reason": reason}, postmortem=postmortem)
+        self.last_reason = reason
+        return reason
+
+    # -- the save itself ---------------------------------------------------
+
+    def _meta(self, step: int, data_meta, extra_meta) -> dict:
+        meta: Dict[str, Any] = {"step": int(step), "wall_ts": time.time()}
+        if self.engine is not None:
+            meta["elastic"] = self.engine.elastic_descriptor()
+        if data_meta:
+            meta["data"] = dict(data_meta)
+        if extra_meta:
+            meta.update(extra_meta)
+        return meta
+
+    def _host_snapshot(self, state):
+        """TrainState copied to host numpy arrays, or None when any leaf
+        is not fully addressable (multi-host) — the async path's defence
+        against the step's buffer donation.  Only the addressability
+        check gates the fallback: a real snapshot failure must raise,
+        not silently degrade every save to the synchronous path."""
+        if any(
+            getattr(x, "is_fully_addressable", True) is False
+            for x in jax.tree.leaves(state)
+        ):
+            return None
+        # copy=True, not asarray: on CPU backends np.asarray can be a
+        # ZERO-COPY view of the device buffer, which donation would
+        # reuse under the writer thread — committing garbage
+        return jax.tree.map(lambda x: np.array(x, copy=True), state)
+
+    def save(self, state, step: int, *, data_meta: Optional[dict] = None,
+             extra_meta: Optional[dict] = None,
+             postmortem: bool = False, sync: bool = False) -> None:
+        """Kick one checkpoint of `state` at `step` (async unless `sync`
+        or the manager was built with async_save=False)."""
+        self.wait()  # one in-flight save; also surfaces a prior failure
+        directory = self.directory
+        if postmortem:
+            directory = os.path.join(self.directory, "postmortem")
+            self.postmortems += 1
+            self._last_postmortem_step = step
+            if self.telemetry is not None:
+                self.telemetry.counter("checkpoint_postmortems").inc()
+        meta = self._meta(step, data_meta, extra_meta)
+        snapshot = None
+        if self.async_save and not sync:
+            snapshot = self._host_snapshot(state)
+        if snapshot is None:
+            self._write(directory, state, step, meta, background=False,
+                        postmortem=postmortem)
+        else:
+            self._thread = threading.Thread(
+                target=self._write,
+                args=(directory, snapshot, step, meta),
+                kwargs={"postmortem": postmortem},
+                name=f"ckpt-save-{step}", daemon=True,
+            )
+            self._thread.start()
+        if not postmortem:
+            self.last_saved_step = step
+
+    def _write(self, directory, tree, step, meta, background=True,
+               postmortem=False):
+        t0 = time.perf_counter()
+        try:
+            save_checkpoint(
+                directory, tree, step, meta=meta, retries=self.retries,
+                backoff=self.backoff, telemetry=self.telemetry,
+            )
+        except BaseException as e:
+            if not background:
+                raise
+            # background writer: stash the failure for the training
+            # thread — wait()/the next maybe_save re-raises it there
+            self._pending_exc = e
+            return
+        finally:
+            dt = time.perf_counter() - t0
+            if self.telemetry is not None:
+                self.telemetry.gauge("checkpoint_save_s", dt)
+        if postmortem:
+            return  # postmortem counter already bumped in save(); the
+            # saves counter and checkpoint_last_step gauge advertise the
+            # RESUME chain (schema: "last COMMITTED checkpoint") and a
+            # postmortem step is invisible to latest_step by design
+        self.saves += 1
+        if self.telemetry is not None:
+            self.telemetry.counter("checkpoint_saves").inc()
+            self.telemetry.gauge("checkpoint_last_step", step)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def note_step(self) -> None:
+        """Call once per training step: counts steps whose compute ran
+        while a save was in flight (the async-overlap measurement)."""
+        if self._thread is not None and self._thread.is_alive():
+            self.overlap_steps += 1
+            if self.telemetry is not None:
+                self.telemetry.gauge(
+                    "checkpoint_overlap_steps", self.overlap_steps
+                )
+
+    def _raise_pending(self) -> None:
+        if self._pending_exc is not None:
+            exc, self._pending_exc = self._pending_exc, None
+            raise RuntimeError(
+                "background checkpoint save failed"
+            ) from exc
+
+    def wait(self) -> None:
+        """Join any in-flight save; re-raises its failure here (the
+        thread's exception must not die silently — a run that believes
+        it is checkpointed when it is not loses everything at the next
+        preemption)."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._raise_pending()
+
+    def close(self) -> None:
+        self.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        # drain the writer even when the loop raised — but do not let a
+        # background-save failure mask the original exception
+        try:
+            self.close()
+        except RuntimeError:
+            if exc == (None, None, None):
+                raise
+        return False

@@ -1,0 +1,217 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Mesh-shape-changing resume: restore a checkpoint saved on N devices
+onto an M-device mesh.
+
+Why this works at all: every engine derives its ZeRO partition tables and
+NamedShardings from the mesh it is constructed on (parallel/engine.py),
+and Orbax stores GLOBAL arrays — so restoring into a fresh engine's
+`state_target()` on the new mesh IS the reshard: each device reads only
+the slices its new NamedSharding assigns it, zero extra copies, and
+uneven tails are exact because the global shapes never changed.  What
+does NOT carry over is topology-shaped state:
+
+  * `TrainState.grad_residual` — the quantized-grad-comm error-feedback
+    residual has global shape (n_devices, padded_elems): on a topology
+    change it is re-derived (zeroed) and one step's quantization error
+    goes uncompensated (the same contract as restoring a checkpoint
+    saved without error feedback).
+  * the data stream — the checkpoint meta records the global SAMPLE
+    offset.  An unchanged global batch replays the per-batch stream
+    bit-exactly from that offset (`data_offset_batches` +
+    TokenLoader.seek_samples); a CHANGED global batch has no per-batch
+    continuation (that stream is keyed by batch counter and size), so
+    the examples switch to the per-sample indexed stream
+    (TokenLoader(indexed=True)) at the saved offset — batch-size
+    invariant from there on.
+  * configurations that pin state to mesh positions — pipeline stage
+    slabs, MoE expert placement, tensor/sequence-parallel layouts —
+    cannot reshape and are REFUSED with both mesh shapes in the message
+    (check_reshapeable).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..parallel.mesh import describe_mesh
+from ..utils.checkpoint import (
+    _resolve_step, _restore, _step_dir, _fill_legacy_leaves, read_meta,
+)
+
+# non-data mesh axes whose placement is semantic, not just layout: a
+# pipeline stage owns a contiguous layer slab, an expert axis owns
+# specific experts, TP/SP reserve tensor dims — none of these reshape by
+# re-slicing global arrays alone
+_PINNED_AXES = ("pipe", "expert", "model", "seq")
+
+
+def check_reshapeable(saved: Optional[Dict[str, Any]], engine,
+                      new: Optional[Dict[str, Any]] = None) -> bool:
+    """Validate that `engine` can accept a checkpoint written by the
+    engine described by `saved` (an `elastic_descriptor()` dict from the
+    checkpoint meta).  Returns True when the topology CHANGED (elastic
+    handling needed), False when it matches exactly.  Raises ValueError —
+    naming both mesh shapes — for configs that cannot reshape.  `new`
+    lets a caller that already built `engine.elastic_descriptor()` pass
+    it in instead of deriving it twice.
+    """
+    if new is None:
+        new = engine.elastic_descriptor()
+    if saved is None:
+        # pre-resilience checkpoint: no descriptor to compare — assume
+        # same-topology (the plain load_checkpoint contract) but say so
+        warnings.warn(
+            "checkpoint has no elastic descriptor (pre-resilience meta); "
+            "assuming it was saved on an identical mesh — a device-count "
+            "mismatch will surface as an Orbax sharding error",
+            stacklevel=3,
+        )
+        return False
+    same_mesh = saved.get("mesh") == new["mesh"]
+    if same_mesh:
+        return False
+    blockers = sorted(
+        {
+            ax
+            for desc in (saved.get("mesh") or {}, new["mesh"])
+            for ax, size in (desc.get("axes") or {}).items()
+            if ax in _PINNED_AXES and size > 1
+        }
+    )
+    if blockers:
+        raise ValueError(
+            f"cannot elastically resume: checkpoint was saved on mesh "
+            f"{describe_mesh(saved.get('mesh'))} and this engine runs on "
+            f"{describe_mesh(new['mesh'])}, but the {blockers} ax"
+            f"{'es' if len(blockers) > 1 else 'is'} pin"
+            f"{'' if len(blockers) > 1 else 's'} state to mesh positions "
+            f"(pipeline stage slabs / MoE expert placement / TP+SP tensor "
+            f"layouts) — only the 'data' axis supports shape-changing "
+            f"resume; restore on a matching mesh or re-shard offline"
+        )
+    return True
+
+
+def elastic_load(
+    directory: str,
+    engine,
+    step: Optional[int] = None,
+    retries: int = 3,
+    backoff: float = 0.5,
+    telemetry=None,
+) -> Tuple[Any, Dict[str, Any]]:
+    """Restore the latest (or `step`) COMMITTED checkpoint into `engine`,
+    tolerating a different device count than it was saved on.
+
+    Returns `(state, info)`: `state` lands in the engine's shardings on
+    the CURRENT mesh; `info` is a JSON-safe resume report (feeds the
+    `resume` telemetry record) carrying the old/new mesh descriptors, the
+    data offset from the meta sidecar, what happened to topology-shaped
+    leaves, and how many params changed greedy owner in the re-derived
+    partition table.
+    """
+    step = _resolve_step(directory, step)
+    path = _step_dir(directory, step)
+    meta = read_meta(directory, step) or {}
+    saved = meta.get("elastic")
+    new_desc = engine.elastic_descriptor()
+    changed = check_reshapeable(saved, engine, new=new_desc)
+
+    target = engine.state_target()
+    saved_res = (saved or {}).get("residual_shape")
+    eng_res = new_desc["residual_shape"]
+    residual_action = "kept"
+    drop_residual = False
+    if saved_res != eng_res:
+        # topology-shaped leaf: (n_devices, padded_elems) cannot be
+        # re-sliced meaningfully — restore the saved tensor as-is (its
+        # global shape, replicated) only to satisfy the tree structure,
+        # then re-derive.  When the checkpoint predates the meta sidecar
+        # (saved_res None from a no-meta save) the engine-shaped target
+        # either matches (same topology) or the restore surfaces it.
+        if saved_res is not None:
+            # numpy target -> Orbax restores this doomed leaf to HOST
+            # memory: the residual is ~a full fp32 gradient, and a
+            # replicated device restore would transiently occupy every
+            # device exactly on the near-HBM-limit runs that
+            # restore-instead-of-init exists for — it is discarded
+            # right below
+            target = dataclasses.replace(
+                target,
+                grad_residual=np.zeros(tuple(saved_res), np.float32),
+            )
+            drop_residual = True
+            residual_action = (
+                "rederived" if eng_res is not None else "dropped"
+            )
+        elif eng_res is not None and saved:
+            # meta present and says: saved WITHOUT a residual; the
+            # engine-target would ask Orbax for a leaf that isn't there
+            # (handled by the legacy zero-fill below)
+            target = dataclasses.replace(target, grad_residual=None)
+            drop_residual = True
+            residual_action = "zero_filled"
+
+    state = _restore(path, target, retries=retries, backoff=backoff,
+                     telemetry=telemetry)
+    if drop_residual:
+        state = dataclasses.replace(state, grad_residual=None)
+        if eng_res is not None:
+            warnings.warn(
+                f"grad_residual re-derived for the new topology "
+                f"(saved {saved_res} -> engine {eng_res}): one step's "
+                f"quantization error goes uncompensated",
+                stacklevel=2,
+            )
+    state = _fill_legacy_leaves(state, engine)
+
+    moved = 0
+    if changed and saved and saved.get("n_shard"):
+        from ..parallel.partition import repartition_delta
+        moved = len(repartition_delta(
+            engine.model.param_shapes(),
+            int(saved["n_shard"]), engine.n_shard,
+        ))
+    info = {
+        "resumed_step": int(step),
+        "elastic": bool(changed),
+        "old_mesh": (saved or {}).get("mesh"),
+        "new_mesh": new_desc["mesh"],
+        "residual_action": residual_action,
+        "moved_params": int(moved),
+    }
+    if "data" in meta:
+        info["data"] = meta["data"]
+    return state, info
+
+
+def data_offset_batches(info_or_meta: Dict[str, Any],
+                        global_batch: int) -> Optional[int]:
+    """How many batches of the CURRENT run's `global_batch` the loader
+    must skip so the resumed stream continues at the checkpoint's global
+    sample offset — None when the checkpoint carries no data meta (the
+    caller falls back to step-count replay).  Raises when the offset is
+    not batch-aligned for the new geometry (a half-consumed batch cannot
+    be resumed without sample-indexed loading — use
+    TokenLoader(indexed=True), whose seek_samples accepts any offset).
+    """
+    data = info_or_meta.get("data") or {}
+    samples = data.get("samples_seen")
+    if samples is None:
+        return None
+    samples = int(samples)
+    if samples % int(global_batch):
+        raise ValueError(
+            f"checkpoint data offset {samples} samples is not divisible "
+            f"by the current global batch {global_batch} (saved with "
+            f"global batch {data.get('global_batch')}); use an indexed "
+            f"loader (TokenLoader(indexed=True).seek_samples) or pick a "
+            f"batch size that divides the offset"
+        )
+    return samples // int(global_batch)

@@ -1,0 +1,113 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Straggler mitigation: rebalance per-host data-shard sizes.
+
+PR 5 built the ATTRIBUTION — `Telemetry.sample_stragglers` gathers each
+host's uncoupled host-side prep wall and gauges `straggler_frac`.  This
+module acts on it: when the fraction stays above a threshold for
+`patience` consecutive samples (hysteresis — one GC pause must not
+re-shard the fleet), per-host batch shares are recomputed
+inverse-proportionally to the measured walls, so the slow host prepares
+fewer samples per step and the others absorb the difference.  The GLOBAL
+batch is preserved exactly (the optimizer semantics must not drift), and
+every host keeps at least `min_share` samples (a host with zero share
+would drop out of the data-parallel collective's expectations).
+
+The rebalance applies to HOST-side data preparation only — the device
+mesh and its sharding stay fixed.  A host feeding fewer samples pads its
+per-device shard usage unevenly only when shares are not divisible by the
+host's device count; callers that need device-exact sharding round
+`min_share` up to local device multiples.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+def rebalance_shares(walls: Sequence[float], global_batch: int,
+                     min_share: int = 1) -> List[int]:
+    """Integer per-host batch shares ∝ measured speed (1/wall), summing
+    EXACTLY to `global_batch`, each >= `min_share` (largest-remainder
+    rounding).  Hosts reporting no wall (<= 0) are treated as fastest."""
+    n = len(walls)
+    if n == 0:
+        raise ValueError("no hosts to rebalance")
+    if global_batch < n * min_share:
+        raise ValueError(
+            f"global batch {global_batch} cannot give {n} hosts "
+            f"min_share={min_share} each"
+        )
+    floor = max(1e-9, min((w for w in walls if w > 0), default=1e-9))
+    speed = [1.0 / max(w, floor) for w in walls]
+    total = sum(speed)
+    spendable = global_batch - n * min_share
+    ideal = [min_share + spendable * s / total for s in speed]
+    shares = [int(x) for x in ideal]
+    rema = sorted(
+        range(n), key=lambda i: ideal[i] - shares[i], reverse=True
+    )
+    for i in range(global_batch - sum(shares)):
+        shares[rema[i % n]] += 1
+    return shares
+
+
+class ShardRebalancer:
+    """Hysteresis wrapper: feed each straggler sample's per-host walls to
+    `observe`; after `patience` consecutive samples with
+    straggler_frac >= threshold it returns the new per-host shares (and
+    re-arms), else None.
+
+        reb = ShardRebalancer(global_batch=64, threshold=0.3, patience=3)
+        shares = reb.observe(record["step_s_by_host"],
+                             frac=record["straggler_frac"])
+        if shares is not None:
+            loader.set_host_share(shares[jax.process_index()])  # caller's
+    """
+
+    def __init__(self, global_batch: int, *, threshold: float = 0.25,
+                 patience: int = 3, min_share: int = 1, telemetry=None):
+        self.global_batch = int(global_batch)
+        self.threshold = float(threshold)
+        self.patience = int(patience)
+        self.min_share = int(min_share)
+        self.telemetry = telemetry
+        self.streak = 0
+        self.events = 0
+        self.last_shares: Optional[List[int]] = None
+
+    @staticmethod
+    def straggler_frac(walls: Sequence[float]) -> float:
+        """(slowest - median) / slowest — the PR-5 attribution formula."""
+        if not walls:
+            return 0.0
+        s = sorted(walls)
+        n = len(s)
+        med = s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+        worst = s[-1]
+        return (worst - med) / worst if worst > 0 else 0.0
+
+    def observe(self, walls: Sequence[float],
+                frac: Optional[float] = None) -> Optional[List[int]]:
+        """`frac`: pass the straggler record's already-gauged
+        `straggler_frac` so the rebalance triggers on EXACTLY the value
+        telemetry logged (the local fallback's plain median can differ
+        from the record's _quantile interpolation on even host counts);
+        computed from `walls` when omitted."""
+        if frac is None:
+            frac = self.straggler_frac(walls)
+        if len(walls) > 1 and frac >= self.threshold:
+            self.streak += 1
+        else:
+            self.streak = 0
+        if self.streak < self.patience:
+            return None
+        self.streak = 0
+        self.events += 1
+        self.last_shares = rebalance_shares(
+            walls, self.global_batch, self.min_share
+        )
+        if self.telemetry is not None:
+            self.telemetry.counter("straggler_rebalances").inc()
+        return self.last_shares

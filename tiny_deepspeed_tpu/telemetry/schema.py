@@ -33,8 +33,10 @@ _NUM = (int, float)
 # field-level validation below is what hard-fails).
 #   1: step + run_meta/telemetry_summary records (PR "In-step telemetry")
 #   2: + trace / flight / straggler meta kinds, schema_version stamp,
-#      per-layer health fields (this PR)
-SCHEMA_VERSION = 2
+#      per-layer health fields
+#   3: + resume / fault meta kinds (resilience subsystem: elastic resume
+#      reports, chaos fault-injection log) and checkpoint gauges (this PR)
+SCHEMA_VERSION = 3
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -71,6 +73,12 @@ META_KINDS = (
     "flight",
     # multi-host straggler attribution (Telemetry.sample_stragglers)
     "straggler",
+    # elastic-resume report: which checkpoint was restored onto which
+    # mesh, what was re-derived (resilience/elastic.py::elastic_load)
+    "resume",
+    # chaos fault-injection log: one record per injected fault
+    # (resilience/chaos.py), and straggler-rebalance mitigation events
+    "fault",
 )
 
 META_FIELDS: Dict[str, tuple] = {
@@ -122,6 +130,22 @@ META_FIELDS: Dict[str, tuple] = {
     "counters": dict,
     "gauges": dict,
     "histograms": dict,
+    # resume record (resilience/elastic.py::elastic_load info)
+    "resumed_step": int,
+    "elastic": bool,
+    "old_mesh": (dict, type(None)),
+    "new_mesh": dict,
+    "residual_action": str,
+    "moved_params": int,
+    "data": dict,
+    "checkpoint_dir": str,
+    # fault record (resilience/chaos.py fault log + rebalance events)
+    "fault": str,
+    "at_step": int,
+    "path": str,
+    "attempts": int,
+    "action": str,
+    "shares": list,
 }
 
 
@@ -244,4 +268,12 @@ GAUGES: Dict[str, str] = {
                       "host's time the median host would not have spent",
     "straggler_slowest_host": "process index of the slowest host",
     "straggler_slowest_step_s": "the slowest host's step wall time",
+    "checkpoint_save_s": "wall time of the last checkpoint save "
+                         "(Orbax write + atomic commit; measured in the "
+                         "async writer thread)",
+    "checkpoint_last_step": "step number of the last COMMITTED "
+                            "checkpoint",
+    "checkpoint_overlap_steps": "training steps whose compute ran while "
+                                "an async checkpoint save was in flight "
+                                "(the steps hidden behind I/O)",
 }

@@ -1,7 +1,7 @@
 # Copyright 2026 tiny-deepspeed-tpu authors
 # SPDX-License-Identifier: Apache-2.0
 
-"""Checkpoint/resume for sharded train state (Orbax-backed).
+"""Checkpoint/resume for sharded train state (Orbax-backed), preemption-safe.
 
 The reference has NO save/load anywhere — no state_dict on its optimizers,
 no torch.save (SURVEY §5.4: "none").  Here sharded-pytree checkpointing is
@@ -11,17 +11,73 @@ materialization on any single host).
 
     save_checkpoint(dir, state, step)
     state = load_checkpoint(dir, engine, step=None)      # None -> latest
+
+Preemption safety (the resilience subsystem rides on these guarantees):
+
+  * atomic commit — the payload is written into a dot-prefixed tmp dir,
+    os.rename'd to its final `step_XXXXXXXX` name, then a `COMMITTED`
+    marker file is dropped inside.  A reader therefore never sees a
+    half-written checkpoint under a `step_*` name, and a crash between
+    rename and marker leaves a dir that `latest_step` SKIPS (an Orbax
+    `_CHECKPOINT_METADATA` file is accepted as a legacy commit signal for
+    checkpoints written before the marker existed — it is Orbax's own
+    atomic-finalize artifact, absent from partial copies).
+  * bounded retry — transient I/O failures around the Orbax save/restore
+    are retried with exponential backoff; the final exception names the
+    path and attempt count, and a telemetry `checkpoint_retries` counter
+    records every retry.
+  * meta sidecar — `save_checkpoint(..., meta={...})` persists a JSON
+    document (mesh descriptor, data offset, ...) next to the payload;
+    `read_meta` returns it.  The elastic-resume path
+    (tiny_deepspeed_tpu/resilience/elastic.py) keys off it.
+
+Fault injection: `set_io_hook(fn)` installs a callable invoked at the
+"write" (before the Orbax save) and "commit" (after the tmp write, before
+the rename) phases of every save attempt.  The resilience chaos harness
+uses it to inject transient write failures (retried) and
+`CheckpointKilled` (NOT retried — it simulates the process dying between
+tmp-write and commit, so the partial dir is left behind exactly as a real
+SIGKILL would).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import json
 import os
-from typing import Optional
+import shutil
+import time
+import warnings
+from typing import Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+COMMIT_MARKER = "COMMITTED"
+META_FILE = "ckpt_meta.json"
+# Orbax's own atomic-finalize artifact: present in any checkpoint its
+# finalizer completed, absent from partial copies — the legacy commit
+# signal for pre-marker checkpoints
+_ORBAX_COMMIT = "_CHECKPOINT_METADATA"
+
+
+class CheckpointKilled(RuntimeError):
+    """Raised by a fault-injection hook to simulate the writer dying
+    mid-save.  Never retried: it must propagate so the partially written
+    state on disk looks exactly like a real preemption's."""
+
+
+_io_hook: Optional[Callable] = None
+
+
+def set_io_hook(fn: Optional[Callable]) -> None:
+    """Install (or clear, with None) the save-path fault-injection hook:
+    `fn(phase, path, attempt)` with phase in {"write", "commit"}; raising
+    makes that attempt fail (CheckpointKilled aborts the save outright,
+    anything else is retried with backoff)."""
+    global _io_hook
+    _io_hook = fn
 
 
 def _checkpointer():
@@ -33,95 +89,277 @@ def _step_dir(directory: str, step: int) -> str:
     return os.path.join(os.path.abspath(directory), f"step_{step:08d}")
 
 
-def latest_step(directory: str) -> Optional[int]:
-    """Largest saved step number, or None."""
+def _is_committed(path: str) -> bool:
+    return (
+        os.path.exists(os.path.join(path, COMMIT_MARKER))
+        or os.path.exists(os.path.join(path, _ORBAX_COMMIT))
+    )
+
+
+def list_steps(directory: str) -> Tuple[List[int], List[str]]:
+    """(committed step numbers ascending, skipped uncommitted dir names).
+
+    A dir counts only when its name parses as `step_<int>` AND it carries
+    a commit signal; everything else `step_`-prefixed is reported as
+    skipped so callers can say WHY a resume went further back than
+    expected (a partially written or empty `step_*` dir used to win
+    `max(steps)` and crash the restore)."""
     if not os.path.isdir(directory):
-        return None
-    steps = []
-    for name in os.listdir(directory):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name[len("step_"):]))
-            except ValueError:
-                continue
-    return max(steps) if steps else None
+        return [], []
+    committed, skipped = [], []
+    for name in sorted(os.listdir(directory)):
+        if not name.startswith("step_"):
+            continue
+        try:
+            step = int(name[len("step_"):])
+        except ValueError:
+            skipped.append(name)
+            continue
+        if _is_committed(os.path.join(directory, name)):
+            committed.append(step)
+        else:
+            skipped.append(name)
+    return sorted(committed), skipped
 
 
-def save_checkpoint(directory: str, state, step: int) -> str:
-    """Write `state` (any pytree of jax.Arrays, e.g. TrainState) at `step`."""
+def latest_step(directory: str) -> Optional[int]:
+    """Largest COMMITTED step number, or None.  Uncommitted/partial
+    `step_*` dirs (a crashed writer's leavings) are skipped."""
+    committed, _ = list_steps(directory)
+    return committed[-1] if committed else None
+
+
+def _multihost_barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+def _with_retries(fn, what: str, *, retries: int, backoff: float,
+                  telemetry=None):
+    """Run `fn(attempt)` under the checkpoint I/O retry contract: bounded
+    attempts with exponential backoff (`backoff * 2**attempt` sleeps),
+    `checkpoint_retries` counted on `telemetry`, CheckpointKilled
+    re-raised untouched (a simulated writer death must leave partial
+    state exactly as a real kill would — no cleanup, no retry), and a
+    final RuntimeError naming `what` and the attempt count."""
+    attempts = int(retries) + 1
+    last_err: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return fn(attempt)
+        except CheckpointKilled:
+            raise
+        except Exception as e:  # transient I/O: back off and retry
+            last_err = e
+            if attempt < attempts - 1:
+                if telemetry is not None:
+                    telemetry.counter("checkpoint_retries").inc()
+                time.sleep(backoff * (2 ** attempt))
+    raise RuntimeError(
+        f"{what} failed after {attempts} attempt(s); "
+        f"last error: {last_err!r}"
+    ) from last_err
+
+
+def save_checkpoint(directory: str, state, step: int, *,
+                    meta: Optional[dict] = None, retries: int = 3,
+                    backoff: float = 0.5, telemetry=None) -> str:
+    """Write `state` (any pytree of jax/numpy arrays, e.g. TrainState) at
+    `step`, atomically: tmp dir -> rename -> COMMITTED marker.
+
+    `meta` is persisted as a JSON sidecar (read_meta) — the elastic-resume
+    path stores the mesh descriptor and data offset there.  Transient I/O
+    failures retry up to `retries` times with exponential backoff
+    (`backoff * 2**attempt` seconds); `telemetry.counter(
+    "checkpoint_retries")` counts them when a Telemetry is passed.
+    """
+    directory = os.path.abspath(directory)
+    if jax.process_index() == 0:
+        os.makedirs(directory, exist_ok=True)
     path = _step_dir(directory, step)
-    ckptr = _checkpointer()
-    ckptr.save(path, state)
-    ckptr.wait_until_finished()
-    return path
+    tmp = os.path.join(directory, f".tmp_step_{step:08d}")
+    if os.path.exists(path) and _is_committed(path):
+        # never silently destroy a committed checkpoint — and without
+        # this check the os.rename below would burn every retry on
+        # ENOTEMPTY before failing with a misleading message
+        raise FileExistsError(
+            f"checkpoint step {step} already committed at {path}; "
+            f"delete it first to re-save this step"
+        )
+    if jax.process_count() > 1:
+        # a per-host retry around a collective save would desync the
+        # barrier tags (the failing host re-enters attempt k+1's
+        # barriers while the others wait inside attempt k's) and hang
+        # the fleet: fail fast — the job-level restart IS the
+        # multi-host retry
+        retries = 0
+
+    def _attempt(attempt):
+        if os.path.exists(path):
+            if _is_committed(path) and attempt > 0:
+                # a prior attempt of THIS call died between its rename
+                # landing and the marker write (rename is atomic and
+                # only runs after Orbax finished, so the payload is
+                # complete): just (re)drop the marker instead of
+                # burning the remaining retries on ENOTEMPTY renames
+                if jax.process_index() == 0:
+                    with open(os.path.join(path, COMMIT_MARKER),
+                              "w") as f:
+                        f.write(f"step={step}\nts={time.time()}\n")
+                return path
+            # a previous writer (or a prior attempt that failed between
+            # rename and marker) left an uncommitted dir at the final
+            # path: the payload may be complete but cannot be trusted —
+            # replace it, else os.rename below fails with ENOTEMPTY
+            if jax.process_index() == 0:
+                shutil.rmtree(path, ignore_errors=True)
+            _multihost_barrier(f"ckpt_clean_{step}_{attempt}")
+        if _io_hook is not None:
+            _io_hook("write", tmp, attempt)
+        if jax.process_index() == 0 and os.path.exists(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        _multihost_barrier(f"ckpt_tmp_{step}_{attempt}")
+        ckptr = _checkpointer()
+        ckptr.save(tmp, state)
+        ckptr.wait_until_finished()
+        if jax.process_index() == 0 and meta is not None:
+            with open(os.path.join(tmp, META_FILE), "w") as f:
+                json.dump(meta, f, indent=1, sort_keys=True)
+        if _io_hook is not None:
+            _io_hook("commit", tmp, attempt)
+        _multihost_barrier(f"ckpt_commit_{step}_{attempt}")
+        if jax.process_index() == 0:
+            os.rename(tmp, path)
+            with open(os.path.join(path, COMMIT_MARKER), "w") as f:
+                f.write(f"step={step}\nts={time.time()}\n")
+        _multihost_barrier(f"ckpt_done_{step}_{attempt}")
+        return path
+
+    return _with_retries(
+        _attempt, f"checkpoint save of step {step} to {path}",
+        retries=retries, backoff=backoff, telemetry=telemetry,
+    )
+
+
+def read_meta(directory: str, step: int) -> Optional[dict]:
+    """The JSON meta sidecar saved with `save_checkpoint(..., meta=...)`,
+    or None (no sidecar / unreadable — pre-resilience checkpoints have
+    none)."""
+    p = os.path.join(_step_dir(directory, step), META_FILE)
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fill_legacy_leaves(state, engine):
+    """Post-restore repairs shared by plain and elastic loads: legacy
+    checkpoints missing dropout_base / grad_residual leaves get working
+    substitutes in the engine's shardings."""
+    if engine._dropout_shardings is not None and state.dropout_base is None:
+        # legacy checkpoint (saved before the dropout base moved into
+        # TrainState): Orbax fills the absent leaf with None, which
+        # would crash the first step.  Fall back to the fixed base the
+        # old engine replayed after restore — identical masks to
+        # resuming on the old code, just not seed-derived.
+        warnings.warn(
+            "checkpoint has no dropout_base (pre-round-4 format); "
+            "using the legacy fixed mask-stream base — re-save to "
+            "upgrade",
+            stacklevel=3,
+        )
+        base = jax.device_put(
+            jax.random.PRNGKey(0xD0), engine._dropout_shardings
+        )
+        state = dataclasses.replace(state, dropout_base=base)
+    if getattr(engine, "_residual_shardings", None) is not None \
+            and state.grad_residual is None:
+        # checkpoint saved without grad_comm error feedback (or on a
+        # different topology): resume with a zero residual — the feedback
+        # loop re-fills it within a step; only the one step's
+        # quantization error goes uncompensated
+        state = dataclasses.replace(
+            state,
+            grad_residual=jax.jit(
+                functools.partial(
+                    jnp.zeros, engine._residual_shape, jnp.float32
+                ),
+                out_shardings=engine._residual_shardings,
+            )(),
+        )
+    return state
+
+
+def _restore(path: str, target, retries: int = 3, backoff: float = 0.5,
+             telemetry=None):
+    """Orbax restore with the same bounded retry/backoff as the save."""
+    if jax.process_count() > 1:
+        # same reasoning as save_checkpoint: the restore is collective
+        # (every process reads its shards of the global arrays) — one
+        # host retrying alone diverges from the rest
+        retries = 0
+    return _with_retries(
+        lambda attempt: _checkpointer().restore(path, target),
+        f"checkpoint restore from {path}",
+        retries=retries, backoff=backoff, telemetry=telemetry,
+    )
+
+
+def _resolve_step(directory: str, step: Optional[int]) -> int:
+    committed, skipped = list_steps(directory)
+    if step is None:
+        if not committed:
+            extra = (
+                f" (skipped uncommitted/partial dirs: {skipped} — a "
+                f"crashed writer's leavings; delete them or re-save)"
+                if skipped else ""
+            )
+            raise FileNotFoundError(
+                f"no committed checkpoints under {directory}{extra}"
+            )
+        return committed[-1]
+    if step not in committed:
+        path = _step_dir(directory, step)
+        if os.path.isdir(path):
+            raise FileNotFoundError(
+                f"checkpoint step {step} under {directory} exists but "
+                f"is not committed (no {COMMIT_MARKER} marker — the "
+                f"writer likely died mid-save); committed steps: "
+                f"{committed}"
+            )
+        raise FileNotFoundError(
+            f"no checkpoint step {step} under {directory}; committed "
+            f"steps: {committed}"
+        )
+    return step
 
 
 def load_checkpoint(directory: str, engine=None, step: Optional[int] = None,
-                    target=None):
+                    target=None, retries: int = 3, backoff: float = 0.5,
+                    telemetry=None):
     """Restore a checkpoint.
 
     With `engine`, the restored TrainState lands directly in the engine's
     resting shardings (params replicated or ZeRO-3-sharded, optimizer state
     ZeRO-sharded) — each device reads only its shard.  Alternatively pass an
     explicit `target` pytree of ShapeDtypeStruct(+sharding).
+
+    Only COMMITTED checkpoints are considered (atomic-save contract above);
+    partial dirs are skipped and named in the error when nothing restorable
+    remains.  To restore onto a mesh with a DIFFERENT device count than
+    the checkpoint was saved on, use
+    `tiny_deepspeed_tpu.resilience.elastic.elastic_load` — it re-derives
+    topology-dependent leaves; this plain loader assumes the layout
+    matches.
     """
-    if step is None:
-        step = latest_step(directory)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
+    step = _resolve_step(directory, step)
     path = _step_dir(directory, step)
 
     if target is None and engine is not None:
-        from ..parallel.engine import TrainState
-
-        shapes = jax.eval_shape(
-            lambda: engine.init(jax.random.PRNGKey(0))
-        )
-        shardings = TrainState(
-            params=engine._param_shardings,
-            opt_state=engine._opt_shardings,
-            scaler=engine._scaler_shardings,
-            dropout_base=engine._dropout_shardings,
-            grad_residual=getattr(engine, "_residual_shardings", None),
-        )
-        target = jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            shapes,
-            shardings,
-        )
-        state = _checkpointer().restore(path, target)
-        if engine._dropout_shardings is not None \
-                and state.dropout_base is None:
-            # legacy checkpoint (saved before the dropout base moved into
-            # TrainState): Orbax fills the absent leaf with None, which
-            # would crash the first step.  Fall back to the fixed base the
-            # old engine replayed after restore — identical masks to
-            # resuming on the old code, just not seed-derived.
-            import warnings
-            warnings.warn(
-                "checkpoint has no dropout_base (pre-round-4 format); "
-                "using the legacy fixed mask-stream base — re-save to "
-                "upgrade",
-                stacklevel=2,
-            )
-            base = jax.device_put(
-                jax.random.PRNGKey(0xD0), engine._dropout_shardings
-            )
-            state = dataclasses.replace(state, dropout_base=base)
-        if getattr(engine, "_residual_shardings", None) is not None \
-                and state.grad_residual is None:
-            # checkpoint saved without grad_comm error feedback (or
-            # pre-round-6): resume with a zero residual — the feedback
-            # loop re-fills it within a step; only the one step's
-            # quantization error goes uncompensated
-            state = dataclasses.replace(
-                state,
-                grad_residual=jax.jit(
-                    functools.partial(
-                        jnp.zeros, engine._residual_shape, jnp.float32
-                    ),
-                    out_shardings=engine._residual_shardings,
-                )(),
-            )
-        return state
-    return _checkpointer().restore(path, target)
+        state = _restore(path, engine.state_target(), retries=retries,
+                         backoff=backoff, telemetry=telemetry)
+        return _fill_legacy_leaves(state, engine)
+    return _restore(path, target, retries=retries, backoff=backoff,
+                    telemetry=telemetry)
