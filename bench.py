@@ -74,6 +74,10 @@ def _config_fingerprint(env=None) -> str:
         "gather_prefetch": env.get("BENCH_GATHER_PREFETCH", ""),
         "gather_groups": env.get("BENCH_GATHER_GROUPS", ""),
         "gather_quant": env.get("BENCH_GATHER_QUANT", ""),
+        "serve": env.get("BENCH_SERVE", ""),
+        "serve_quant": env.get("BENCH_SERVE_QUANT", ""),
+        "serve_active": env.get("BENCH_SERVE_ACTIVE", ""),
+        "serve_rate": env.get("BENCH_SERVE_RATE", ""),
     }, sort_keys=True)
 
 
@@ -215,12 +219,13 @@ def _retry_or_diagnose(exc: BaseException) -> None:
     # config the cache was saved under — a deterministic failure (compile
     # OOM, lowering error) must surface as 0.0 + error, not as last
     # round's healthy number
-    if os.environ.get("BENCH_DECODE"):
-        # decode mode has its own metric name and no last-good cache (the
-        # cache holds TRAIN throughput — replaying it here would report a
-        # train number as a decode result)
+    if os.environ.get("BENCH_DECODE") or os.environ.get("BENCH_SERVE"):
+        # decode/serve modes have their own metric names and no last-good
+        # cache (the cache holds TRAIN throughput — replaying it here
+        # would report a train number as a decode/serve result)
+        mode = "serve" if os.environ.get("BENCH_SERVE") else "decode"
         print(json.dumps({
-            "metric": f"{model_name}_decode_tokens_per_sec",
+            "metric": f"{model_name}_{mode}_tokens_per_sec",
             "value": 0.0,
             "unit": "tokens/s",
             "vs_baseline": 0.0,
@@ -721,6 +726,85 @@ def run_decode(model_name: str, b=8, prompt_t=128, new_tokens=256):
     }
 
 
+def run_serve(model_name: str, b=None, t=None):
+    """Serving-tier throughput: continuous batching over the paged KV
+    pool under the synthetic arrivals driver (serving/driver.py — the
+    same code path scripts/serve_bench.py and the tests drive), tokens/s
+    with p50/p99 per-token latency and batch occupancy in extra.
+    BENCH_SERVE=1 selects this mode.
+
+    Fingerprint/staleness conventions: the BENCH_SERVE* knobs are part
+    of `_config_fingerprint`, so a serve invocation can neither replay
+    nor overwrite the default train-throughput last-good cache; serve
+    itself keeps no cache (like BENCH_DECODE — a substituted number
+    would need the top-level `stale` flag, and there is nothing honest
+    to substitute), so the error path emits value 0.0 + error."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    from tiny_deepspeed_tpu.models import ALL_PRESETS, build_model
+    from tiny_deepspeed_tpu.serving import ServeConfig, ServingEngine
+    from tiny_deepspeed_tpu.serving.driver import (
+        Arrival, poisson_trace, run_trace,
+    )
+
+    del b, t
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "12"))
+    max_new = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "64"))
+    max_active = int(os.environ.get("BENCH_SERVE_ACTIVE", "4"))
+    quant = os.environ.get("BENCH_SERVE_QUANT") or None
+    rate = os.environ.get("BENCH_SERVE_RATE")
+    rate = float(rate) if rate else None  # default: closed-loop capacity
+    prompt_lens = [int(x) for x in os.environ.get(
+        "BENCH_SERVE_PROMPTS", "32,64,128").split(",")]
+
+    base = ALL_PRESETS[model_name]
+    cfg = _dc.replace(base, param_dtype=jnp.bfloat16, remat=False,
+                      scan_unroll=base.n_layer <= 24)
+    model = build_model(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    bt = 16
+    # full capacity for max_active worst-case requests (+1 slack block):
+    # occupancy, not preemption, is what this record measures; the
+    # decode panel sizes to the workload, not the model context
+    worst = -(-(max(prompt_lens) + max_new) // bt)
+    serve_cfg = ServeConfig(
+        max_active=max_active, num_blocks=max_active * worst + 1,
+        block_tokens=bt, quant=quant, temperature=0.0,
+        max_seq_tokens=min(worst * bt, cfg.block_size),
+    )
+
+    eng = ServingEngine(model, params, serve_cfg)
+    # warm on the SAME engine (fresh engines own fresh jit closures):
+    # one request per distinct prompt length covers every prefill
+    # bucket, closed-loop covers the decode step — compiles stay out of
+    # the measured wall, and no Poisson sleeps during warmup
+    run_trace(eng, [Arrival(0.0, [0] * p, min(2, max_new))
+                    for p in sorted(set(prompt_lens))], realtime=False)
+    trace = poisson_trace(
+        n_req, rate_rps=rate, prompt_lens=prompt_lens,
+        max_new_tokens=max_new, vocab_size=cfg.vocab_size, seed=0,
+    )
+    res = run_trace(eng, trace, realtime=rate is not None)
+    return {
+        "metric": f"{model_name}_serve_tokens_per_sec",
+        "value": res["tokens_per_s"],
+        "unit": "tokens/s",
+        "extra": {
+            "requests": n_req, "max_new_tokens": max_new,
+            "max_active": max_active, "rate_rps": rate,
+            "kv_quant": quant, "prompt_lens": prompt_lens,
+            "p50_token_latency_ms": res["token_latency"]["p50_ms"],
+            "p99_token_latency_ms": res["token_latency"]["p99_ms"],
+            "ttft_p50_ms": res["ttft"]["p50_ms"],
+            "occupancy": res["mean_occupancy"],
+            "pool_utilization": res["mean_pool_utilization"],
+            "pool_kv_bytes": eng.pool.kv_bytes()["kv_block_bytes"],
+        },
+    }
+
+
 def _round_number(path: str) -> int:
     m = re.search(r"BENCH_r(\d+)\.json$", path)
     return int(m.group(1)) if m else -1
@@ -858,6 +942,11 @@ def main():
     b = os.environ.get("BENCH_BATCH")
     t = int(os.environ.get("BENCH_SEQ", "1024"))
     try:
+        if os.environ.get("BENCH_SERVE"):
+            rec = run_serve(model_name)
+            rec["vs_baseline"] = 1.0
+            print(json.dumps(rec))
+            return
         if os.environ.get("BENCH_DECODE"):
             rec = run_decode(model_name, b=int(b) if b else 8)
             rec["vs_baseline"] = 1.0

@@ -31,25 +31,15 @@ import numpy as np
 
 
 def tokenize(text: str, tokenizer: str) -> np.ndarray:
-    if tokenizer == "byte":
-        return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(
-            np.uint16
-        )
-    if tokenizer == "gpt2":
-        try:
-            from transformers import GPT2TokenizerFast
-            tok = GPT2TokenizerFast.from_pretrained(
-                "gpt2", local_files_only=True
-            )
-        except Exception as e:  # noqa: BLE001 - explain the offline gate
-            raise SystemExit(
-                "--tokenizer gpt2 needs the tokenizer files in the local "
-                f"HuggingFace cache (this environment has no network): {e!r}"
-                "\nUse --tokenizer byte instead."
-            )
-        ids = tok(text)["input_ids"]
-        return np.asarray(ids, dtype=np.uint16)
-    raise SystemExit(f"unknown tokenizer {tokenizer!r}")
+    """Delegates to the shared tokenizer library (data/tokenizer.py —
+    also what examples/generate.py encodes --prompt text with), keeping
+    CLI-friendly SystemExit error surfacing."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tiny_deepspeed_tpu.data import tokenizer as tok
+    try:
+        return tok.encode(text, tokenizer)
+    except (RuntimeError, ValueError) as e:
+        raise SystemExit(str(e))
 
 
 def main():

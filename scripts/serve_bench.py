@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Serving-tier load driver: synthetic Poisson arrivals through the
+continuous-batching engine, reporting aggregate tokens/s at p50/p99
+per-token latency — the serving headline the ROADMAP asks for.
+
+    # max-pressure (closed-loop) smoke on the CPU backend:
+    python scripts/serve_bench.py --model tiny --cpu --requests 16 \
+        --max-active 4 --closed-loop
+
+    # open-loop Poisson at 2 req/s, with the serial generate() baseline:
+    python scripts/serve_bench.py --model tiny --cpu --rate 2 --serial
+
+    # quantized KV blocks:
+    python scripts/serve_bench.py --model tiny --cpu --kv-quant int8
+
+Prints a human summary plus ONE machine-readable JSON line (the same
+shape bench.py's BENCH_SERVE record embeds in `extra`); --jsonl writes
+the per-request `request` records + telemetry summary through the
+standard metrics schema (render with scripts/report_run.py)."""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--cpu", action="store_true", help="force CPU backend")
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--rate", type=float, default=None, metavar="RPS",
+                   help="Poisson arrival rate (default: closed loop — "
+                        "all requests arrive at t=0)")
+    p.add_argument("--closed-loop", action="store_true",
+                   help="ignore arrival times; keep the engine saturated")
+    p.add_argument("--prompt-lens", default="8,16,32",
+                   help="comma list the trace samples prompts from")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--max-active", type=int, default=4)
+    p.add_argument("--num-blocks", type=int, default=64)
+    p.add_argument("--block-tokens", type=int, default=16)
+    p.add_argument("--max-seq-tokens", type=int, default=0,
+                   help="per-request length ceiling sizing the compiled "
+                        "decode panel (0 = auto: max prompt + max new, "
+                        "rounded to a block)")
+    p.add_argument("--kv-quant", default=None, choices=("int8", "fp8"))
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--serial", action="store_true",
+                   help="also run the one-at-a-time generate() baseline "
+                        "on the same trace and report the ratio")
+    p.add_argument("--jsonl", default=None, metavar="PATH",
+                   help="write per-request records + telemetry summary "
+                        "as a metrics JSONL stream")
+    args = p.parse_args(argv)
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from tiny_deepspeed_tpu.models import ALL_PRESETS, build_model
+    from tiny_deepspeed_tpu.serving import ServeConfig, ServingEngine
+    from tiny_deepspeed_tpu.serving.driver import poisson_trace, run_trace
+    from tiny_deepspeed_tpu.telemetry import Telemetry
+
+    model = build_model(args.model)
+    cfg = model.config
+    params = model.init(jax.random.PRNGKey(args.seed))
+    prompt_lens = [int(x) for x in args.prompt_lens.split(",")]
+    trace = poisson_trace(
+        args.requests, rate_rps=args.rate,
+        prompt_lens=prompt_lens,
+        max_new_tokens=args.max_new_tokens, vocab_size=cfg.vocab_size,
+        seed=args.seed,
+    )
+
+    tel = Telemetry()
+    logger = None
+    if args.jsonl:
+        from tiny_deepspeed_tpu.telemetry.schema import SCHEMA_VERSION
+        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
+        if os.path.exists(args.jsonl):
+            os.remove(args.jsonl)
+        logger = MetricsLogger(args.jsonl, stdout=False)
+        logger.log_meta(schema_version=SCHEMA_VERSION,
+                        engine=f"serve:{args.model}",
+                        model=args.model, devices=jax.device_count())
+
+    bt = args.block_tokens
+    max_seq = args.max_seq_tokens or min(
+        cfg.block_size,
+        -(-(max(prompt_lens) + args.max_new_tokens) // bt) * bt,
+    )
+
+    eng = ServingEngine(
+        model, params,
+        ServeConfig(
+            max_active=args.max_active, num_blocks=args.num_blocks,
+            block_tokens=bt, quant=args.kv_quant,
+            temperature=args.temperature, top_k=args.top_k,
+            seed=args.seed, max_seq_tokens=max_seq,
+        ),
+    )
+    # warm run on the SAME engine (each engine owns fresh jit closures,
+    # so warming a throwaway one buys nothing): one request per DISTINCT
+    # prompt length covers every power-of-two prefill bucket, closed-loop
+    # covers the decode step — the measured pass then reports serving
+    # throughput, not XLA compile time.  Telemetry/logger attach after,
+    # so warm requests pollute neither counters nor the JSONL.
+    from tiny_deepspeed_tpu.serving.driver import Arrival
+    warm = [
+        Arrival(0.0, [0] * plen, min(2, args.max_new_tokens))
+        for plen in sorted(set(prompt_lens))
+    ]
+    run_trace(eng, warm, realtime=False)
+    eng.telemetry, eng.logger = tel, logger
+
+    res = run_trace(eng, trace, realtime=not args.closed_loop
+                    and args.rate is not None)
+    res.pop("outputs")
+    res.pop("requests")
+
+    summary = {
+        "model": args.model,
+        "requests": args.requests,
+        "rate_rps": args.rate,
+        "max_active": args.max_active,
+        "kv_quant": args.kv_quant,
+        "tokens_per_s": res["tokens_per_s"],
+        "token_latency": res["token_latency"],
+        "ttft": res["ttft"],
+        "mean_occupancy": res["mean_occupancy"],
+        "mean_pool_utilization": res["mean_pool_utilization"],
+        "evictions": res["evictions"],
+        "preemptions": res["preemptions"],
+        "pool": eng.pool.kv_bytes(),
+    }
+    if args.serial:
+        from tiny_deepspeed_tpu.serving.driver import run_serial
+        ser = run_serial(model, params, trace,
+                         temperature=args.temperature, top_k=args.top_k)
+        summary["serial_tokens_per_s"] = ser["tokens_per_s"]
+        summary["vs_serial"] = round(
+            res["tokens_per_s"] / max(ser["tokens_per_s"], 1e-9), 3)
+
+    print(f"served {args.requests} requests, {res['tokens']} tokens in "
+          f"{res['wall_s']}s -> {res['tokens_per_s']} tok/s "
+          f"(occupancy {res['mean_occupancy']:.2f}, "
+          f"p50 {res['token_latency']['p50_ms']}ms / "
+          f"p99 {res['token_latency']['p99_ms']}ms per token)")
+    if args.serial:
+        print(f"serial generate() baseline: "
+              f"{summary['serial_tokens_per_s']} tok/s -> "
+              f"{summary['vs_serial']}x")
+    print(json.dumps(summary))
+
+    if logger is not None:
+        tel.flush(logger)
+        logger.close()
+        print(f"request records -> {args.jsonl}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,41 @@ def test_no_new_tracked_shared_objects():
     )
 
 
+def _load_tier1_times():
+    # the session gate's loader is the one under test — share it rather
+    # than keeping a second copy of the importlib boilerplate in sync
+    from conftest import _tier1_times
+    return _tier1_times()
+
+
+def test_tier1_budget_check_predicate():
+    """The shared budget predicate (scripts/tier1_times.budget_check):
+    CLI --budget exit codes and the conftest session gate both ride it,
+    so its pass/fail boundary is pinned here."""
+    m = _load_tier1_times()
+    ok, msg = m.budget_check(100.0, 870.0)
+    assert ok and "within budget" in msg
+    ok, msg = m.budget_check(871.0, 870.0)
+    assert not ok and "EXCEEDED" in msg and "slow" in msg
+    # the CLI surfaces it as exit code 1 on a parsed log
+    durations = [(500.0, "call", "tests/test_a.py::t"),
+                 (400.0, "call", "tests/test_b.py::t")]
+    assert m.report(durations, budget=870.0) == 1
+    assert m.report(durations, budget=1000.0) == 0
+
+
+def test_tier1_budget_gate_is_wired_into_conftest():
+    """The session gate must stay wired: tests/conftest.py imports the
+    budget predicate from scripts/tier1_times.py and applies it at
+    sessionfinish — removing the hook would silently re-open the
+    truncation failure mode the budget exists to catch."""
+    with open(os.path.join(REPO, "tests", "conftest.py")) as f:
+        text = f.read()
+    assert "def pytest_sessionfinish" in text
+    assert "budget_check" in text
+    assert "tier1_times" in text
+
+
 def test_gauge_names_documented_in_schema():
     """Name-drift guard: every telemetry gauge registered by a literal
     `.gauge("name", ...)` call anywhere in the package/scripts/bench must

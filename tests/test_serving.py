@@ -1,0 +1,490 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Serving tier: paged KV pool, continuous batching, quantized cache.
+
+Acceptance pins (ISSUE 7):
+  * paged decode is token-exact with `GPT2Model.generate` greedy, per
+    request, under concurrency and staggered admission;
+  * pool accounting is exact at every scheduler tick (blocks-in-use ==
+    sum of active block-table lengths) and freed blocks are reused
+    deterministically without corrupting neighbors;
+  * int8/fp8 cache blocks quarter the pool's resting KV bytes vs f32
+    (asserted from array dtypes/shapes) within decode-parity tolerance;
+  * importing/instantiating the serving package leaves the TRAINING
+    step's HLO byte-identical (subprocess-pinned, fresh import order);
+  * the Poisson soak (slow tier): >= 4 concurrent requests beat the
+    same trace served one-at-a-time through `generate`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tiny_deepspeed_tpu import GPTConfig, GPT2Model
+
+# small-and-fast config (test_model.py's TestKVCacheDecode family): XLA-CPU
+# compiles of the serving programs dominate this module's budget
+CFG = dict(block_size=64, vocab_size=128, n_layer=2, n_head=2,
+           n_embd=32, compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPT2Model(GPTConfig(**CFG))
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.PRNGKey(0))
+
+
+def _prompt(seed, n, vocab=128):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab),
+        np.int32,
+    ).tolist()
+
+
+def _ref_tokens(model, params, prompt, new):
+    out = model.generate(
+        params, np.asarray(prompt, np.int32)[None, :], new,
+        temperature=0.0,
+    )
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _serve_config(**kw):
+    from tiny_deepspeed_tpu.serving import ServeConfig
+    kw.setdefault("max_active", 3)
+    kw.setdefault("num_blocks", 24)
+    kw.setdefault("block_tokens", 8)
+    return ServeConfig(**kw)
+
+
+def _assert_accounting(eng):
+    used = sum(len(t) for t in eng.active_block_tables().values())
+    assert used == eng.pool.blocks_in_use, (
+        f"pool accounting drift: tables hold {used}, pool reports "
+        f"{eng.pool.blocks_in_use}"
+    )
+
+
+class TestSamplingCore:
+    """ONE sampling core (models/sampling.py) for generate + serving."""
+
+    def test_greedy_is_argmax_and_ignores_key(self):
+        from tiny_deepspeed_tpu.models.sampling import sample_logits
+        logit = jnp.asarray(np.random.default_rng(0).normal(
+            size=(3, 16)).astype(np.float32))
+        a = sample_logits(logit, jax.random.PRNGKey(0), 0.0, None)
+        b = sample_logits(logit, jax.random.PRNGKey(7), 0.0, None)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            np.asarray(a), np.argmax(np.asarray(logit), -1))
+
+    def test_top_k_restricts_support(self):
+        from tiny_deepspeed_tpu.models.sampling import sample_logits
+        logit = jnp.asarray(
+            np.arange(12, dtype=np.float32)[None, :])  # top-2 = {10, 11}
+        for seed in range(8):
+            t = int(sample_logits(
+                logit, jax.random.PRNGKey(seed), 1.0, 2)[0])
+            assert t in (10, 11)
+
+    def test_generate_sample_delegates_to_core(self, monkeypatch):
+        """GPT2Model._sample IS the shared core, not a drifted copy."""
+        from tiny_deepspeed_tpu.models import sampling
+        calls = {}
+        orig = sampling.sample_logits
+
+        def spy(logit, key, temperature, top_k=None):
+            calls["hit"] = True
+            return orig(logit, key, temperature, top_k)
+
+        monkeypatch.setattr(sampling, "sample_logits", spy)
+        GPT2Model._sample(jnp.zeros((1, 4)), jax.random.PRNGKey(0),
+                          0.0, None)
+        assert calls.get("hit")
+
+
+class TestContinuousBatching:
+    def test_staggered_greedy_parity_and_exact_accounting(
+            self, model, params):
+        """Requests admitted and evicted at DIFFERENT ticks (two shape
+        groups, second wave submitted mid-flight) each reproduce their
+        `generate` tokens exactly, with pool accounting exact at every
+        tick — the continuous-batching core contract."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params, _serve_config())
+        specs = [(1, 7, 10), (2, 13, 6)]
+        reqs = [eng.submit(_prompt(s, n), new) for s, n, new in specs]
+        for _ in range(3):
+            eng.tick()
+            _assert_accounting(eng)
+        late = [(3, 7, 10), (4, 13, 6)]  # same shapes: no new compiles
+        reqs += [eng.submit(_prompt(s, n), new) for s, n, new in late]
+        ticks = 0
+        while eng.queue_depth or eng.n_active:
+            eng.tick()
+            _assert_accounting(eng)
+            ticks += 1
+            assert ticks < 100
+        assert eng.pool.blocks_in_use == 0
+        for r, (s, n, new) in zip(reqs, specs + late):
+            assert len(r.tokens) == new
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens),
+                _ref_tokens(model, params, r.prompt, new),
+                err_msg=f"request {r.id} diverged from generate()",
+            )
+            assert r.state == "done" and r.finish_reason == "length"
+
+    def test_block_boundary_prompt_parity(self, model, params):
+        """Prompt length exactly on a block boundary (p % block_tokens
+        == 0): the first decode write lands at position p, i.e. in a
+        block BEYOND ceil(p/bt) — admission must allocate it up front
+        or that K/V silently lands in the scratch block and every later
+        token attends to a hole.  Token-exact parity pins it."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params, _serve_config())
+        specs = [(11, 8, 6), (12, 16, 6)]  # p == bt and p == 2*bt
+        reqs = [eng.submit(_prompt(s, n), new) for s, n, new in specs]
+        ticks = 0
+        while eng.queue_depth or eng.n_active:
+            eng.tick()
+            _assert_accounting(eng)
+            ticks += 1
+            assert ticks < 50
+        for r, (s, n, new) in zip(reqs, specs):
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens),
+                _ref_tokens(model, params, r.prompt, new),
+                err_msg=f"boundary request {r.id} diverged",
+            )
+
+    def test_block_realloc_after_eviction_is_clean(self, model, params):
+        """A request admitted AFTER an eviction reuses the evictee's
+        freed blocks (the free list is LIFO, so they come back first)
+        without corrupting the still-active neighbor."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        # 2 slots: r2 must WAIT until short-lived r0 finishes; r0's
+        # blocks are the most recently freed when r2 admits
+        eng = ServingEngine(model, params,
+                            _serve_config(max_active=2, num_blocks=6))
+        r0 = eng.submit(_prompt(1, 7), 6)    # finishes first
+        r1 = eng.submit(_prompt(2, 13), 10)  # active throughout
+        eng.tick()
+        r0_blocks = set(eng.active_block_tables()[r0.id])
+        r2 = eng.submit(_prompt(3, 13), 6)
+        ticks = 0
+        r2_blocks = None
+        while eng.queue_depth or eng.n_active:
+            eng.tick()
+            _assert_accounting(eng)
+            if r2.state == "active" and r2_blocks is None:
+                r2_blocks = set(eng.active_block_tables()[r2.id])
+                assert r0.done  # admission had to wait for the eviction
+                assert r1.state == "active"  # the neighbor lives on
+            ticks += 1
+            assert ticks < 100
+        assert r2_blocks is not None and r2_blocks & r0_blocks, (
+            "r2 was expected to reuse blocks freed by r0"
+        )
+        for r, new in ((r0, 6), (r1, 10), (r2, 6)):
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens),
+                _ref_tokens(model, params, r.prompt, new),
+                err_msg=f"request {r.id} corrupted across realloc",
+            )
+
+    def test_refusals(self, model, params):
+        from tiny_deepspeed_tpu import MoEConfig, MoEGPT
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        with pytest.raises(ValueError, match="paged_decode_capable"):
+            ServingEngine(MoEGPT(MoEConfig(n_expert=2, **CFG)), params,
+                          _serve_config())
+        with pytest.raises(ValueError, match="must divide"):
+            ServingEngine(model, params, _serve_config(block_tokens=7))
+        with pytest.raises(ValueError, match="KV-cache quant"):
+            ServingEngine(model, params, _serve_config(quant="int4"))
+        eng = ServingEngine(model, params, _serve_config(num_blocks=2))
+        with pytest.raises(ValueError, match="blocks"):
+            eng.submit(_prompt(1, 30), 30)  # can never fit the pool
+        with pytest.raises(ValueError, match="block_size"):
+            eng.submit(_prompt(1, 60), 30)  # exceeds the model context
+
+
+class TestQuantizedCache:
+    def test_pool_bytes_quartered_from_dtypes(self):
+        """int8/fp8 pools rest at 1 byte/element vs the f32 baseline's 4
+        — asserted from the device arrays' dtypes and shapes, not a
+        model.  (On a bf16-compute config the same blocks HALVE.)"""
+        from tiny_deepspeed_tpu.serving.pool import PagedKVPool
+        kw = dict(n_layer=2, kv_heads=2, head_dim=16, num_blocks=8,
+                  block_tokens=8)
+        base = PagedKVPool(dtype=jnp.float32, **kw).kv_bytes()
+        half = PagedKVPool(dtype=jnp.bfloat16, **kw).kv_bytes()
+        assert half["kv_block_bytes"] * 2 == base["kv_block_bytes"]
+        for quant, dt in (("int8", jnp.int8), ("fp8", jnp.float8_e4m3fn)):
+            q = PagedKVPool(dtype=jnp.float32, quant=quant, **kw)
+            b = q.kv_bytes()
+            assert jnp.dtype(q.view.k.dtype) == jnp.dtype(dt)
+            assert b["itemsize"] == 1
+            assert b["kv_block_bytes"] * 4 == base["kv_block_bytes"]
+            assert b["scale_bytes"] > 0  # f32 absmax per head vector
+
+    def test_codec_roundtrip_error_bounded(self):
+        """paged_append -> paged_panel through an int8 pool stays within
+        the blockwise-absmax codec's per-element bound (scale/2, scale =
+        vector absmax / 127) — the grad-comm machinery reused verbatim."""
+        from tiny_deepspeed_tpu.serving.pool import (
+            PagedKVPool, page_ref, paged_append, paged_panel,
+        )
+        dh, kvh, s = 16, 2, 3
+        pool = PagedKVPool(n_layer=1, kv_heads=kvh, head_dim=dh,
+                           num_blocks=4, block_tokens=4,
+                           dtype=jnp.float32, quant="int8")
+        rng = np.random.default_rng(0)
+        k = rng.normal(size=(s, kvh, dh)).astype(np.float32)
+        v = rng.normal(size=(s, kvh, dh)).astype(np.float32)
+        tables = np.asarray([[1, 0], [2, 0], [3, 0]], np.int32)
+        ref = page_ref(jnp.asarray(tables), jnp.zeros((s,), jnp.int32), 4)
+        view = paged_append(pool.view, jnp.asarray(k), jnp.asarray(v), 0,
+                            ref)
+        ck, cv = paged_panel(view, 0, ref, jnp.float32)
+        got_k = np.asarray(ck)[:, :, 0, :]  # position 0 of each panel
+        got_v = np.asarray(cv)[:, :, 0, :]
+        for got, ref_a in ((got_k, k), (got_v, v)):
+            bound = np.abs(ref_a).max(-1, keepdims=True) / 127.0 * 0.5001
+            assert (np.abs(got - ref_a) <= bound + 1e-7).all()
+
+    @pytest.mark.parametrize("quant", ["int8", "fp8"])
+    def test_quantized_decode_parity_tolerance(self, model, params,
+                                               quant):
+        """Quantized-cache greedy decode tracks the f32 reference: the
+        prefill/first token is exact (full-precision forward), and the
+        decode logits stay close enough that tokens rarely flip at this
+        scale."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params,
+                            _serve_config(quant=quant, max_active=2))
+        specs = [(1, 7, 8), (2, 13, 8)]
+        reqs = [eng.submit(_prompt(s, n), new) for s, n, new in specs]
+        eng.drain(max_ticks=200)
+        for r, (s, n, new) in zip(reqs, specs):
+            ref = _ref_tokens(model, params, r.prompt, new)
+            assert len(r.tokens) == new
+            assert r.tokens[0] == ref[0], "prefill token must be exact"
+            agree = float((np.asarray(r.tokens) == ref).mean())
+            assert agree >= 0.75, (
+                f"{quant} cache diverged: {agree:.2f} agreement"
+            )
+
+
+class TestCacheDtypeKnob:
+    def test_bf16_cache_greedy_parity_with_full_forward(self):
+        """cache_dtype="bf16" on an f32-compute config: cached greedy
+        decode still equals the uncached full-forward tokens (seed-
+        pinned) — retiring gpt2.py's '(future-knob) cache dtype'."""
+        m = GPT2Model(GPTConfig(cache_dtype="bf16", **CFG))
+        p = m.init(jax.random.PRNGKey(0))
+        idx = np.asarray(_prompt(5, 7), np.int32)[None, :]
+        a = m.generate(p, idx, 10, temperature=0.0, use_cache=True)
+        b = m.generate(p, idx, 10, temperature=0.0, use_cache=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and the cache really rests narrower: the serving pool derives
+        # its dtype from the same resolver
+        from tiny_deepspeed_tpu.models.gpt2 import resolved_cache_dtype
+        assert resolved_cache_dtype(m.config) == jnp.bfloat16
+
+    def test_resolver(self):
+        from tiny_deepspeed_tpu.models.gpt2 import resolved_cache_dtype
+        assert resolved_cache_dtype(GPTConfig(**CFG)) == jnp.float32
+        assert resolved_cache_dtype(
+            GPTConfig(cache_dtype=jnp.float16, **CFG)) == jnp.float16
+        with pytest.raises(ValueError, match="cache_dtype"):
+            resolved_cache_dtype(GPTConfig(cache_dtype="int8", **CFG))
+
+
+class TestServingTelemetry:
+    def test_gauges_counters_and_request_records(self, model, params,
+                                                 tmp_path):
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.telemetry import Telemetry
+        from tiny_deepspeed_tpu.telemetry import schema
+        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
+        tel = Telemetry()
+        path = str(tmp_path / "serve.jsonl")
+        with MetricsLogger(path, stdout=False) as ml:
+            ml.log_meta(schema_version=schema.SCHEMA_VERSION,
+                        engine="serve:test")
+            eng = ServingEngine(model, params, _serve_config(),
+                                telemetry=tel, logger=ml)
+            reqs = [eng.submit(_prompt(1, 7), 10),
+                    eng.submit(_prompt(2, 7), 10)]
+            eng.drain(max_ticks=200)
+            tel.flush(ml)
+        assert all(r.done for r in reqs)
+        g = tel.gauges
+        assert g["serve_batch_occupancy"] == 0.0  # drained
+        assert g["serve_pool_utilization"] == 0.0
+        assert g["serve_queue_depth"] == 0.0
+        assert g["serve_eviction_rate"] > 0.0
+        assert tel.counters["serve_tokens"].value == 20
+        assert tel.counters["serve_evictions"].value == 2
+        # every serve gauge name is documented (the schema drift guard
+        # enforces the same via grep; this pins the registry side)
+        for name in g:
+            assert name in schema.GAUGES
+        counts, errs = schema.validate_file(path)
+        assert not errs, errs
+        with open(path) as f:
+            kinds = [json.loads(ln).get("kind") for ln in f]
+        assert kinds.count("request") == 2
+
+    def test_driver_closed_loop_smoke(self, model, params):
+        """poisson_trace + run_trace (the serve_bench/BENCH_SERVE code
+        path), closed-loop so the smoke never sleeps."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.serving.driver import (
+            poisson_trace, run_trace,
+        )
+        trace = poisson_trace(3, rate_rps=None, prompt_lens=[7, 13],
+                              max_new_tokens=5, vocab_size=128, seed=0)
+        assert [a.at_s for a in trace] == [0.0, 0.0, 0.0]
+        eng = ServingEngine(model, params, _serve_config())
+        res = run_trace(eng, trace, realtime=False)
+        assert res["tokens"] == 15 and res["tokens_per_s"] > 0
+        assert len(res["outputs"]) == 3
+        assert set(res["token_latency"]) == {"p50_ms", "p99_ms",
+                                             "mean_ms"}
+        assert 0 < res["mean_occupancy"] <= 1.0
+
+
+class TestOffPathSafety:
+    def test_training_hlo_identical_with_serving_imported(self):
+        """The training step's HLO is byte-identical with the serving
+        package imported AND a live ServingEngine constructed — in a
+        fresh subprocess, so the import order is genuinely
+        before/after (an in-process pin would be vacuous once any other
+        test imported serving)."""
+        script = r"""
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import sys
+assert not any("serving" in m for m in sys.modules), "import leaked"
+from tiny_deepspeed_tpu import GPTConfig, GPT2Model, SGD, SingleDevice
+cfg = GPTConfig(block_size=32, vocab_size=128, n_layer=2, n_head=2,
+                n_embd=32, compute_dtype=jnp.float32)
+batch = (np.zeros((2, 32), np.int32), np.zeros((2, 32), np.int32))
+eng = SingleDevice(GPT2Model(cfg), SGD(lr=0.1))
+state = eng.init(jax.random.PRNGKey(0))
+before = eng._step.lower(state, batch).as_text()
+from tiny_deepspeed_tpu.serving import ServeConfig, ServingEngine
+model = GPT2Model(cfg)
+se = ServingEngine(model, model.init(jax.random.PRNGKey(0)),
+                   ServeConfig(max_active=2, num_blocks=4,
+                               block_tokens=8))
+eng2 = SingleDevice(GPT2Model(cfg), SGD(lr=0.1))
+state2 = eng2.init(jax.random.PRNGKey(0))
+after = eng2._step.lower(state2, batch).as_text()
+print(json.dumps({"identical": before == after,
+                  "n": len(before)}))
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)  # single-device is enough, and faster
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__))),
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["identical"], (
+            "training HLO changed with serving imported+instantiated"
+        )
+
+
+@pytest.mark.slow
+class TestServingSoak:
+    """Multi-minute acceptance runs: throughput vs serial, preemption."""
+
+    def test_concurrent_beats_serial_at_greedy_parity(self):
+        """>= 4 concurrent requests through the batched engine move more
+        aggregate tokens/s than the same trace served one-at-a-time via
+        `generate` — at token-exact greedy parity per request (the
+        ISSUE's headline acceptance).
+
+        Scale matters on the CPU mesh: below ~6 layers x 256 embd the
+        per-TICK costs that batching amortizes (host round-trip, block-
+        table gathers) exceed the per-token model compute itself and the
+        fully-on-device serial fori_loop wins — measured 0.92x at
+        2Lx32D, 0.71x at 4Lx128D, 12.7x at 6Lx256D (PROFILE.md "Decode
+        under load").  The production claim is the 6x256 point; real
+        serving models are orders of magnitude past the crossover."""
+        import dataclasses
+
+        from tiny_deepspeed_tpu.models import ALL_PRESETS, build_model
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.serving.driver import (
+            poisson_trace, run_serial, run_trace,
+        )
+        cfg_m = dataclasses.replace(
+            ALL_PRESETS["tiny"], n_layer=6, n_embd=256, n_head=4)
+        model = build_model(cfg_m)
+        params = model.init(jax.random.PRNGKey(0))
+        trace = poisson_trace(12, rate_rps=None, prompt_lens=[7, 13],
+                              max_new_tokens=24, vocab_size=512, seed=0)
+        # max_seq_tokens sized to the trace (13 + 24 -> 40): the decode
+        # panel reads 40 positions/slot, comparable to generate's cache
+        cfg = _serve_config(max_active=4, num_blocks=32,
+                            max_seq_tokens=40)
+        eng = ServingEngine(model, params, cfg)
+        # warm both paths on the SAME engine/jits: compiles out of the
+        # measured wall
+        run_trace(eng, trace[:4], realtime=False)
+        run_serial(model, params, trace[:2])
+        res = run_trace(eng, trace, realtime=False)
+        ser = run_serial(model, params, trace)
+        for rid, toks in enumerate(sorted(res["outputs"])):
+            np.testing.assert_array_equal(
+                np.asarray(res["outputs"][toks]),
+                np.asarray(ser["outputs"][rid]),
+                err_msg=f"trace request {rid} diverged from generate()",
+            )
+        assert res["mean_occupancy"] > 0.5  # truly concurrent
+        assert res["tokens_per_s"] > 1.1 * ser["tokens_per_s"], (
+            f"continuous batching {res['tokens_per_s']} tok/s did not "
+            f"beat serial {ser['tokens_per_s']} tok/s"
+        )
+
+    def test_preemption_continues_greedy_exact(self, model, params):
+        """Block exhaustion preempts the youngest request; after
+        re-admission (re-prefilling prompt + produced tokens) its final
+        output is still token-exact with `generate`."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(
+            model, params,
+            _serve_config(max_active=3, num_blocks=5, block_tokens=8))
+        reqs = [eng.submit(_prompt(s, 10), 14) for s in (1, 2, 3)]
+        eng.drain(max_ticks=2000)
+        assert sum(r.preemptions for r in reqs) >= 1, (
+            "pool was sized to force at least one preemption"
+        )
+        for r in reqs:
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens),
+                _ref_tokens(model, params, r.prompt, 14),
+                err_msg=f"request {r.id} diverged after preemption",
+            )

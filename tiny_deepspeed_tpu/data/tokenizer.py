@@ -1,0 +1,68 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""The data tokenizers, as a library: text <-> token ids.
+
+`scripts/prepare_data.py` (corpus -> .bin) and `examples/generate.py`
+(--prompt text -> tokens -> text) share these, so the id space a model
+was trained on is by construction the one its prompts encode into.
+
+  * "byte" — raw UTF-8 bytes, vocab 256.  Always available (no network,
+    no vocab files); pair with models whose vocab_size >= 256.
+  * "gpt2" — transformers GPT2TokenizerFast (vocab 50257, pads into the
+    default 50304).  Only works when the tokenizer files are already in
+    the local HF cache; raises a clear error otherwise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TOKENIZERS = ("byte", "gpt2")
+
+
+def _gpt2_tok():
+    try:
+        from transformers import GPT2TokenizerFast
+        return GPT2TokenizerFast.from_pretrained("gpt2",
+                                                 local_files_only=True)
+    except Exception as e:  # noqa: BLE001 - explain the offline gate
+        raise RuntimeError(
+            "the gpt2 tokenizer needs its files in the local HuggingFace "
+            f"cache (this environment has no network): {e!r}\n"
+            "Use the byte tokenizer instead."
+        ) from e
+
+
+def encode(text: str, tokenizer: str = "byte") -> np.ndarray:
+    """Text -> uint16 token ids (the .bin / TokenLoader convention)."""
+    if tokenizer == "byte":
+        return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(
+            np.uint16
+        )
+    if tokenizer == "gpt2":
+        ids = _gpt2_tok()(text)["input_ids"]
+        return np.asarray(ids, dtype=np.uint16)
+    raise ValueError(f"unknown tokenizer {tokenizer!r}; "
+                     f"choose from {TOKENIZERS}")
+
+
+def decode(ids, tokenizer: str = "byte") -> str:
+    """Token ids -> text.  Byte-tokenizer ids above 255 (a model sampling
+    from a larger vocab) render as replacement characters rather than
+    raising — generated text is best-effort by nature."""
+    ids = np.asarray(ids)
+    if tokenizer == "byte":
+        return bytes(
+            int(t) if 0 <= int(t) < 256 else 0x3F  # '?' for out-of-range
+            for t in ids
+        ).decode("utf-8", errors="replace")
+    if tokenizer == "gpt2":
+        return _gpt2_tok().decode([int(t) for t in ids])
+    raise ValueError(f"unknown tokenizer {tokenizer!r}; "
+                     f"choose from {TOKENIZERS}")
+
+
+def min_vocab(tokenizer: str) -> int:
+    """Smallest model vocab_size the tokenizer's ids fit in."""
+    return {"byte": 256, "gpt2": 50257}[tokenizer]

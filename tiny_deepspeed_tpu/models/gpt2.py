@@ -132,6 +132,16 @@ class GPTConfig:
     # (tpu_batch.sh step 13, VERDICT r4 #8: measure standalone first,
     # adopt only on an end-to-end win).
     fused_xent_impl: str = "chunked"
+    # resting dtype of the decode KV cache (generate(use_cache=True) and
+    # the serving tier's contiguous prefill).  None keeps compute_dtype;
+    # "bf16"/jnp.bfloat16 halves cache HBM on an f32-compute config —
+    # decode is cache-bandwidth bound, and `_decode_attention` already
+    # consumes the cache in its resting dtype with f32 MXU accumulation.
+    # Greedy parity vs the full-forward path is seed-pinned in
+    # tests/test_serving.py.  int8/fp8 cache compression lives in the
+    # PAGED pool only (serving/pool.py), where the per-vector scales have
+    # a place to rest.
+    cache_dtype: Any = None
     # lax.scan unroll factor for the layer stack (True/n_layer = fully
     # unrolled).  Unrolling deletes the scan's stacked activation-stash
     # dynamic-slice traffic — the round-4 TPU profile priced that IO plus
@@ -148,6 +158,34 @@ class GPTConfig:
     @property
     def head_dim(self) -> int:
         return self.n_embd // self.n_head
+
+
+# cache_dtype knob spellings -> jnp dtypes (dtype objects pass through)
+_CACHE_DTYPES = {
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "f16": jnp.float16, "fp16": jnp.float16, "float16": jnp.float16,
+    "f32": jnp.float32, "fp32": jnp.float32, "float32": jnp.float32,
+}
+
+
+def resolved_cache_dtype(cfg) -> Any:
+    """The decode KV cache's resting dtype: config.cache_dtype (string
+    spelling or dtype), defaulting to compute_dtype.  Shared by the
+    in-scan decode cache (`_prefill`) and the serving tier's paged pool
+    (serving/pool.py) so the knob means the same thing on both."""
+    cd = getattr(cfg, "cache_dtype", None)
+    if cd is None:
+        return cfg.compute_dtype
+    if isinstance(cd, str):
+        try:
+            return _CACHE_DTYPES[cd]
+        except KeyError:
+            raise ValueError(
+                f"cache_dtype {cd!r} not understood; use one of "
+                f"{sorted(_CACHE_DTYPES)} or a jnp dtype (int8/fp8 cache "
+                f"compression lives in the paged pool: serving/pool.py)"
+            ) from None
+    return cd
 
 
 # Named presets covering the BASELINE.md workloads.  "tiny" exists so every
@@ -223,6 +261,11 @@ class GPT2Model:
     # through the stacked scan tree; subclasses overriding apply()
     # without the health_probe branch must reset this (MoEGPT does)
     layer_health_capable = True
+    # paged_prefill/paged_decode read and write the serving tier's paged
+    # KV pool (serving/pool.py block tables); families whose decode step
+    # cannot batch rows at different positions (MoE's capacity-routed
+    # dispatch) must reset this — serving.ServingEngine refuses them
+    paged_decode_capable = True
 
     def __init__(self, config: GPTConfig):
         self.config = config
@@ -351,28 +394,35 @@ class GPT2Model:
 
     def _decode_attention(self, q, ck, cv, pos):
         """q: (B, Hq, 1, Dh); ck/cv: (B, Hkv, T, Dh) caches; pos: the
-        query's position (cache filled through pos).  Full-length masked
-        attention — slots past pos are zero padding, masked out.  GQA
+        query's position (cache filled through pos) — a scalar, or a (B,)
+        vector when each row sits at its own position (the serving tier's
+        paged decode batches requests of different lengths).  Full-length
+        masked attention — slots past pos are padding, masked out.  GQA
         (Hq > Hkv) groups query heads per KV head instead of materializing
         a repeated cache.
 
         Decode is HBM-bandwidth bound, so the dots consume the cache in
-        its RESTING dtype with f32 MXU accumulation — the previous
-        `.astype(f32)` on ck/cv materialized two full f32 cache copies
-        per token (~2x the cache bytes; round-5 decode pass).  Scores,
-        mask and softmax stay f32."""
+        its RESTING dtype (config.cache_dtype, default compute_dtype)
+        with f32 MXU accumulation — the previous `.astype(f32)` on ck/cv
+        materialized two full f32 cache copies per token (~2x the cache
+        bytes; round-5 decode pass).  Scores, mask and softmax stay f32."""
         b, hq, _, dh = q.shape
         hkv = ck.shape[1]
         scale = 1.0 / math.sqrt(dh)
         out_dtype = q.dtype  # restore the ACTIVATION dtype on return,
-        q = q.astype(ck.dtype)  # not the (future-knob) cache dtype
-        mask = jnp.arange(ck.shape[2]) <= pos
+        q = q.astype(ck.dtype)  # not the resting cache dtype
+        pos = jnp.asarray(pos)
+        mask = jnp.arange(ck.shape[2]) <= (
+            pos[:, None] if pos.ndim else pos
+        )  # (B, T) per-row, or (T,) shared
+        m4 = (mask[None, None, None] if mask.ndim == 1
+              else mask[:, None, None, :])
         if hq != hkv:
             g = hq // hkv
             att = jnp.einsum(
                 "bkgd,bktd->bkgt", q.reshape(b, hkv, g, dh), ck,
                 preferred_element_type=jnp.float32) * scale
-            att = jnp.where(mask[None, None, None], att, -jnp.inf)
+            att = jnp.where(m4, att, -jnp.inf)
             att = jax.nn.softmax(att, axis=-1)
             y = jnp.einsum("bkgt,bktd->bkgd", att.astype(cv.dtype), cv,
                            preferred_element_type=jnp.float32)
@@ -380,7 +430,7 @@ class GPT2Model:
         else:
             att = jnp.einsum("bhqd,bhtd->bhqt", q, ck,
                              preferred_element_type=jnp.float32) * scale
-            att = jnp.where(mask[None, None, None], att, -jnp.inf)
+            att = jnp.where(m4, att, -jnp.inf)
             att = jax.nn.softmax(att, axis=-1)
             y = jnp.einsum("bhqt,bhtd->bhqd", att.astype(cv.dtype), cv,
                            preferred_element_type=jnp.float32)
@@ -415,14 +465,19 @@ class GPT2Model:
         y = linear(y, self._bw(bp, "attn.proj.w"), bp.get("attn.proj.b"))
         return x + y, ks, vs
 
-    def _block_decode(self, x, bp, ks, vs, l, pos):
-        """One block, one token: cached attention + MLP."""
-        x, ks, vs = self._attn_decode(x, bp, ks, vs, l, pos)
+    def _mlp_decode(self, x, bp):
+        """MLP half of one decode step (norm + MLP + residual) — shared
+        between the contiguous-cache and paged decode paths."""
         h = layernorm(x, bp["ln_2.w"], bp["ln_2.b"])
         h = linear(h, self._bw(bp, "mlp.fc.w"), bp.get("mlp.fc.b"))
         h = jax.nn.gelu(h, approximate=True)
         h = linear(h, self._bw(bp, "mlp.proj.w"), bp.get("mlp.proj.b"))
-        return x + h, ks, vs
+        return x + h
+
+    def _block_decode(self, x, bp, ks, vs, l, pos):
+        """One block, one token: cached attention + MLP."""
+        x, ks, vs = self._attn_decode(x, bp, ks, vs, l, pos)
+        return self._mlp_decode(x, bp), ks, vs
 
     def _prefill_body(self, x, bp):
         """Scan body for the prompt pass: (x, (k, v)).  Families whose
@@ -433,12 +488,18 @@ class GPT2Model:
     def _prefill(self, params, idx, cache_len, stacked=None):
         """Run the prompt, returning final-position logits (B, V) float32
         plus (L, B, Hkv, cache_len, Dh) K/V caches (prompt prefix filled,
-        rest zeros)."""
+        rest zeros).  The caches REST in resolved_cache_dtype(config) —
+        compute_dtype unless the cache_dtype knob narrows it (decode is
+        cache-bandwidth bound; `_decode_attention` consumes the resting
+        dtype directly with f32 accumulation, so a narrower cache halves
+        HBM traffic without touching activation dtypes)."""
         x = self.embed(params, idx)
         if stacked is None:
             stacked = self.stacked_compute_params(params)
         x, (ks, vs) = jax.lax.scan(self._prefill_body, x, stacked,
                                    unroll=self.config.scan_unroll)
+        cdt = resolved_cache_dtype(self.config)
+        ks, vs = ks.astype(cdt), vs.astype(cdt)
         pad = ((0, 0), (0, 0), (0, 0), (0, cache_len - idx.shape[1]), (0, 0))
         return self.head(params, x)[:, 0], jnp.pad(ks, pad), jnp.pad(vs, pad)
 
@@ -465,23 +526,25 @@ class GPT2Model:
         return x, ks, vs
 
     def _embed_decode(self, params, tok, pos):
-        """One token at one position -> (B, 1, D).  tok: (B,) ints."""
+        """One token per row -> (B, 1, D).  tok: (B,) ints; pos: scalar
+        (every row at the same position — `generate`) or (B,) vector
+        (each row at its own position — the serving tier's paged decode,
+        where concurrent requests sit at different lengths)."""
         x = self.embed_tokens(params, tok[:, None])
-        return x + jax.lax.dynamic_slice_in_dim(
-            params["wpe"], pos, 1, 0
-        )[None].astype(x.dtype)
+        if jnp.ndim(pos) == 0:
+            wp = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, 1, 0)[None]
+        else:
+            wp = params["wpe"][pos][:, None]
+        return x + wp.astype(x.dtype)
 
     @staticmethod
     def _sample(logit, key, temperature, top_k):
-        """(B, V) float32 logits -> (B,) int32 next tokens."""
-        if top_k is not None:
-            kth = jax.lax.top_k(logit, top_k)[0][:, -1:]
-            logit = jnp.where(logit < kth, -jnp.inf, logit)
-        if temperature == 0.0:
-            return jnp.argmax(logit, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logit / temperature
-        ).astype(jnp.int32)
+        """(B, V) float32 logits -> (B,) int32 next tokens — delegates to
+        the ONE sampling core (models/sampling.py) shared with the
+        serving tier, so a sampling change lands in every decode surface
+        at once."""
+        from .sampling import sample_logits
+        return sample_logits(logit, key, temperature, top_k)
 
     def _generate_impl_cached(self, params, idx, key, *, t0, max_new_tokens,
                               temperature, top_k):
@@ -512,6 +575,85 @@ class GPT2Model:
         key, sub = jax.random.split(key)
         last = self._sample(logits, sub, temperature, top_k)
         return jax.lax.dynamic_update_slice(buf, last[:, None], (0, total - 1))
+
+    # -- paged KV-cache decode (the serving tier) --------------------------
+    #
+    # Same math as the contiguous decode above, but the cache lives in a
+    # SHARED preallocated block pool (serving/pool.py): each slot's K/V
+    # panel is gathered through its block table instead of sliced from a
+    # per-request max-length buffer, and every slot sits at its OWN
+    # position (vector `pos`).  The attention itself is the existing GQA
+    # `_decode_attention`; only the cache read/write changes.
+
+    def _paged_attn_decode(self, x, bp, view, l, page):
+        """Attention half of one paged decode step.  x: (S, 1, D); view:
+        serving.pool.KVPoolView (the pool arrays, riding the layer-scan
+        carry so writes alias); page: serving.pool.PageRef (block tables
+        + per-slot write coordinates, loop-invariant)."""
+        c = self.config
+        s = x.shape[0]
+        h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
+        qkv = linear(h, self._bw(bp, "attn.qkv.w"), bp.get("attn.qkv.b"))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads1(z):
+            return z.reshape(s, 1, c.n_head, c.head_dim).swapaxes(1, 2)
+
+        from ..serving.pool import paged_append, paged_panel
+        view = paged_append(
+            view, heads1(k)[:, :, 0], heads1(v)[:, :, 0], l, page
+        )
+        ck, cv = paged_panel(view, l, page, c.compute_dtype)
+        y = self._decode_attention(heads1(q), ck, cv, page.pos)
+        y = y.swapaxes(1, 2).reshape(s, 1, c.n_embd)
+        y = linear(y, self._bw(bp, "attn.proj.w"), bp.get("attn.proj.b"))
+        return x + y, view
+
+    def _paged_block_decode(self, x, bp, view, l, page):
+        """One block, one token per slot, cache in the paged pool."""
+        x, view = self._paged_attn_decode(x, bp, view, l, page)
+        return self._mlp_decode(x, bp), view
+
+    def paged_decode(self, stacked, x, view, page):
+        """Layer loop for one paged decode token — the pool view rides
+        the CARRY (like `_decode_blocks`' contiguous caches) so each
+        layer's block write aliases the pool instead of restacking it."""
+        n_layer = jax.tree.leaves(stacked)[0].shape[0]
+
+        def body(carry, l):
+            x, view = carry
+            bp = jax.tree.map(
+                lambda t: jax.lax.dynamic_index_in_dim(
+                    t, l, 0, keepdims=False), stacked)
+            x, view = self._paged_block_decode(x, bp, view, l, page)
+            return (x, view), None
+
+        (x, view), _ = jax.lax.scan(
+            body, (x, view), jnp.arange(n_layer),
+            unroll=self.config.scan_unroll)
+        return x, view
+
+    def paged_prefill(self, params, idx, last_pos, block_ids, view,
+                      block_tokens: int, stacked=None):
+        """Prompt pass for ONE request into the paged pool: idx (1, P)
+        bucket-padded prompt, last_pos (traced) the true last prompt
+        position, block_ids (P/block_tokens,) the physical blocks this
+        request owns (padding-bucket tail entries point at the scratch
+        block).  Returns (last-position logits (1, V) f32, view with the
+        prompt's K/V scattered).  Reuses the training forward via the
+        `return_kv` prefill hook, so family overrides (Llama RoPE/GQA)
+        inherit it.  Pass the precomputed `stacked` compute-dtype tree
+        when params are frozen (the serving engine does) — recomputing
+        it per admission re-reads the full master param tree every
+        prefill."""
+        x = self.embed(params, idx)
+        if stacked is None:
+            stacked = self.stacked_compute_params(params)
+        x, (ks, vs) = jax.lax.scan(self._prefill_body, x, stacked,
+                                   unroll=self.config.scan_unroll)
+        from ..serving.pool import paged_scatter
+        view = paged_scatter(view, ks, vs, block_ids, block_tokens)
+        return self.head(params, x, position=last_pos)[:, 0], view
 
     def embed_tokens(self, params, idx):
         """wte gather (+ optional row-norm cap) -> (B, T, D) compute dtype.

@@ -93,6 +93,26 @@ def rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+def rope_at(x, positions, theta: float):
+    """RoPE for a one-token-per-row batch: x (B, H, 1, Dh), positions (B,)
+    — each row rotated at its OWN position (the serving tier's paged
+    decode, where concurrent requests sit at different lengths).  Same
+    elementwise math as `rope`, so a row at position p gets bit-identical
+    treatment on both paths."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (B, half)
+    cos = jnp.cos(ang)[:, None, None, :]
+    sin = jnp.sin(ang)[:, None, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
 class LlamaModel(GPT2Model):
     """Same functional contract as GPT2Model: init / apply / generate."""
 
@@ -103,6 +123,8 @@ class LlamaModel(GPT2Model):
     grad_bucket_capable = True
     gather_prefetch_capable = True
     layer_health_capable = True
+    # paged decode: _paged_attn_decode below (RoPE at per-slot positions)
+    paged_decode_capable = True
 
     def __init__(self, config: LlamaConfig):
         super().__init__(config)
@@ -243,12 +265,38 @@ class LlamaModel(GPT2Model):
         y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
         return x + linear(y, self._bw(bp, "attn.o.w"), None), ks, vs
 
-    def _block_decode(self, x, bp, ks, vs, l, pos):
-        x, ks, vs = self._attn_decode(x, bp, ks, vs, l, pos)
+    def _mlp_decode(self, x, bp):
         h = rmsnorm(x, bp["ln_2.w"])
         gate = jax.nn.silu(linear(h, self._bw(bp, "mlp.gate.w"), None))
         up = linear(h, self._bw(bp, "mlp.up.w"), None)
-        return x + linear(gate * up, self._bw(bp, "mlp.down.w"), None), ks, vs
+        return x + linear(gate * up, self._bw(bp, "mlp.down.w"), None)
+
+    def _block_decode(self, x, bp, ks, vs, l, pos):
+        x, ks, vs = self._attn_decode(x, bp, ks, vs, l, pos)
+        return self._mlp_decode(x, bp), ks, vs
+
+    def _paged_attn_decode(self, x, bp, view, l, page):
+        """Paged-pool decode attention (GPT2Model contract): separate
+        q/k/v projections, per-row RoPE at each slot's own position,
+        grouped attention over the gathered block panel."""
+        c = self.config
+        b = x.shape[0]
+        hd = c.head_dim
+        h = rmsnorm(x, bp["ln_1.w"])
+        q = linear(h, self._bw(bp, "attn.q.w"), None)
+        k = linear(h, self._bw(bp, "attn.k.w"), None)
+        v = linear(h, self._bw(bp, "attn.v.w"), None)
+        q = q.reshape(b, 1, c.n_head, hd).swapaxes(1, 2)
+        k = k.reshape(b, 1, c.kv_heads, hd).swapaxes(1, 2)
+        v = v.reshape(b, 1, c.kv_heads, hd).swapaxes(1, 2)
+        q = rope_at(q, page.pos, c.rope_theta)
+        k = rope_at(k, page.pos, c.rope_theta)
+        from ..serving.pool import paged_append, paged_panel
+        view = paged_append(view, k[:, :, 0], v[:, :, 0], l, page)
+        ck, cv = paged_panel(view, l, page, c.compute_dtype)
+        y = self._decode_attention(q, ck, cv, page.pos)
+        y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
+        return x + linear(y, self._bw(bp, "attn.o.w"), None), view
 
     def _embed_decode(self, params, tok, pos):
         """No wpe table — position enters via RoPE inside each block."""

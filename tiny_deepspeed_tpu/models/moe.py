@@ -111,6 +111,10 @@ class MoEGPT(GPT2Model):
     # ...nor the per-layer health probe (apply() takes no health_probe);
     # the engine rejects telemetry layers mode for it
     layer_health_capable = False
+    # ...nor the serving tier's paged decode: expert dispatch routes a
+    # whole batch through static per-expert capacity, which a mixed-
+    # position slot batch would skew; serving.ServingEngine refuses it
+    paged_decode_capable = False
     # 1F1B (round 3): the aux loss joins as a constant-cotangent second
     # output of the layer slab (pipeline.py with_aux), so MoE runs the
     # O(S)-memory schedule too

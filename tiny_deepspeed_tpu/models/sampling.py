@@ -1,0 +1,37 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""The ONE sampling core: final-position logits -> next tokens.
+
+Both decode surfaces consume this — `GPT2Model.generate`'s fori-loop body
+and the serving tier's continuous-batching decode step
+(serving/engine.py) — so a sampling change (a new top-p knob, a
+temperature fix) lands in every path at once instead of drifting between
+the one-shot script and the server.  Kept dependency-free (jax only) so
+`tiny_deepspeed_tpu.serving` can import it without touching model code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(logit, key, temperature: float,
+                  top_k: Optional[int] = None):
+    """(B, V) float32 logits -> (B,) int32 next tokens.
+
+    temperature == 0.0 is greedy argmax (key unused); otherwise
+    categorical over logits/temperature, restricted to the top_k logits
+    when top_k is given.  `temperature`/`top_k` are static (compiled
+    into the program) — both call sites key their jit caches on them."""
+    if top_k is not None:
+        kth = jax.lax.top_k(logit, top_k)[0][:, -1:]
+        logit = jnp.where(logit < kth, -jnp.inf, logit)
+    if temperature == 0.0:
+        return jnp.argmax(logit, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logit / temperature
+    ).astype(jnp.int32)

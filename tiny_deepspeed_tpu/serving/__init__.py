@@ -1,0 +1,24 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Production serving tier: continuous batching over a paged KV cache.
+
+Import-side contract: importing this package (or building a
+ServingEngine) changes NOTHING about training — the training step's HLO
+is byte-identical with serving imported but unused, pinned in
+tests/test_serving.py alongside the telemetry=off convention.
+
+  * `pool`   — paged KV block pool + block tables, int8/fp8 cache blocks
+  * `engine` — ServingEngine: prefill/decode phase split, admission,
+               eviction, preemption, telemetry
+  * `driver` — synthetic Poisson-arrivals load driver + the serial
+               `generate()` baseline (bench + tests share it)
+"""
+
+from .engine import Request, ServeConfig, ServingEngine
+from .pool import KVPoolView, PagedKVPool, PageRef
+
+__all__ = [
+    "Request", "ServeConfig", "ServingEngine",
+    "KVPoolView", "PagedKVPool", "PageRef",
+]

@@ -1,0 +1,152 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Synthetic serving load: Poisson arrivals through the engine, and the
+serial `generate()` baseline the continuous-batching numbers are judged
+against.  Shared by `scripts/serve_bench.py`, `bench.py` (BENCH_SERVE)
+and tests/test_serving.py so the three never measure different things.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+
+class Arrival(NamedTuple):
+    """One trace entry: when (seconds from trace start; 0.0 everywhere
+    = closed-loop max-pressure mode), what prompt, how many tokens."""
+
+    at_s: float
+    prompt: List[int]
+    max_new_tokens: int
+
+
+def poisson_trace(n_requests: int, *, rate_rps: Optional[float],
+                  prompt_lens: Sequence[int], max_new_tokens: int,
+                  vocab_size: int, seed: int = 0) -> List[Arrival]:
+    """Exponential inter-arrivals at `rate_rps` (None = all at t=0),
+    prompts drawn uniformly from `prompt_lens` / the vocab.  Seeded —
+    the same trace replays against every engine configuration."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    trace = []
+    for _ in range(n_requests):
+        if rate_rps is not None:
+            t += float(rng.exponential(1.0 / rate_rps))
+        plen = int(rng.choice(np.asarray(prompt_lens)))
+        prompt = rng.integers(0, vocab_size, size=plen).tolist()
+        trace.append(Arrival(t, prompt, max_new_tokens))
+    return trace
+
+
+def _latency_stats(lats: List[float]) -> dict:
+    if not lats:
+        return {"p50_ms": 0.0, "p99_ms": 0.0, "mean_ms": 0.0}
+    a = np.asarray(lats) * 1e3
+    return {
+        "p50_ms": round(float(np.percentile(a, 50)), 3),
+        "p99_ms": round(float(np.percentile(a, 99)), 3),
+        "mean_ms": round(float(a.mean()), 3),
+    }
+
+
+def run_trace(engine, trace: Sequence[Arrival], *,
+              realtime: bool = True, max_ticks: int = 200_000) -> dict:
+    """Drive `engine` (serving.ServingEngine) through the trace.
+
+    realtime=True honors arrival times with wall-clock waits (what the
+    latency percentiles mean under open-loop load); realtime=False
+    submits each arrival as soon as the engine drains ahead of it
+    (closed-loop — tests use it to avoid sleeping).  Returns outputs
+    per request plus aggregate metrics; per-token latency covers every
+    produced token (first token = TTFT)."""
+    requests = []
+    pending = list(trace)
+    occupancy = []
+    pool_util = []
+    t0 = time.monotonic()
+    ticks = 0
+    while pending or engine.queue_depth or engine.n_active:
+        now = time.monotonic() - t0
+        while pending and (not realtime or pending[0].at_s <= now):
+            a = pending.pop(0)
+            requests.append(engine.submit(a.prompt, a.max_new_tokens))
+            if not realtime:
+                break  # one per spin: admission interleaves with decode
+        if (realtime and not engine.queue_depth and not engine.n_active
+                and pending):
+            # open-loop idle: nothing in flight, next arrival is in the
+            # future — wait for it instead of spinning
+            time.sleep(max(0.0, pending[0].at_s - (
+                time.monotonic() - t0)))
+            continue
+        if engine.queue_depth or engine.n_active:
+            engine.tick()
+            occupancy.append(engine.n_active / engine.config.max_active)
+            pool_util.append(
+                engine.pool.blocks_in_use / engine.pool.num_usable)
+        ticks += 1
+        if ticks > max_ticks:
+            raise RuntimeError(f"trace did not drain in {max_ticks} ticks")
+    wall = time.monotonic() - t0
+    toks = sum(len(r.tokens) for r in requests)
+    lats = [lat for r in requests for lat in r.token_lat]
+    return {
+        "outputs": {r.id: list(r.tokens) for r in requests},
+        "requests": requests,
+        "tokens": toks,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(toks / max(wall, 1e-9), 2),
+        "token_latency": _latency_stats(lats),
+        "ttft": _latency_stats(
+            [r.t_first - r.t_arrival for r in requests
+             if r.t_first is not None]),
+        "mean_occupancy": round(float(np.mean(occupancy)), 4)
+        if occupancy else 0.0,
+        "mean_pool_utilization": round(float(np.mean(pool_util)), 4)
+        if pool_util else 0.0,
+        "evictions": engine._evictions,
+        "preemptions": sum(r.preemptions for r in requests),
+    }
+
+
+def run_serial(model, params, trace: Sequence[Arrival], *,
+               temperature: float = 0.0,
+               top_k: Optional[int] = None) -> dict:
+    """The one-at-a-time baseline: the SAME trace through
+    `GPT2Model.generate`, each request starting when the previous
+    finishes (or when it arrives, whichever is later).  Its per-request
+    tokens are also the greedy-parity reference for the batched path."""
+    import jax
+
+    outputs = []
+    lats: List[float] = []
+    t0 = time.monotonic()
+    for i, a in enumerate(trace):
+        now = time.monotonic() - t0
+        if a.at_s > now:
+            time.sleep(a.at_s - now)
+        t_req = time.monotonic()
+        out = model.generate(
+            params, np.asarray(a.prompt, np.int32)[None, :],
+            a.max_new_tokens, temperature=temperature, top_k=top_k,
+            key=jax.random.PRNGKey(i) if temperature != 0.0 else None,
+        )
+        toks = np.asarray(out)[0, len(a.prompt):].tolist()
+        dt = time.monotonic() - t_req
+        outputs.append(toks)
+        # serial tokens surface all at once: attribute the request wall
+        # evenly (the honest per-token number a one-shot script delivers)
+        lats.extend([dt / max(len(toks), 1)] * len(toks))
+    wall = time.monotonic() - t0
+    n = sum(len(o) for o in outputs)
+    return {
+        "outputs": outputs,
+        "tokens": n,
+        "wall_s": round(wall, 4),
+        "tokens_per_s": round(n / max(wall, 1e-9), 2),
+        "token_latency": _latency_stats(lats),
+    }

@@ -1,0 +1,485 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Continuous batching over the paged KV pool.
+
+`GPT2Model.generate` serves exactly one request at a time: fixed shapes,
+one compiled loop, the whole batch enters and leaves together.  Serving
+traffic needs the scheduler in between: `ServingEngine` keeps a FIXED
+array of `max_active` slots (so the compiled decode step never changes
+shape) and, BETWEEN decode steps, admits queued requests, evicts
+finished ones, and returns their pool blocks to the free list — batch
+occupancy stays high because a finished request's slot and blocks are
+reused immediately instead of padding out the longest neighbor.
+
+Phase split, two compiled programs:
+
+  * PREFILL — one request's prompt through the training forward
+    (`paged_prefill`, the `return_kv` hook), K/V scattered into its pool
+    blocks, first token sampled from the true last-prompt position.
+    Prompts pad to power-of-two block-multiple buckets, so distinct
+    compiled shapes stay O(log block_size).
+  * DECODE — ONE token for EVERY active slot: (S, 1, D) activations,
+    each slot reading/writing the pool through its block table at its
+    own position (vector `pos`).  Invalid slots carry scratch
+    coordinates; no branch, no recompile as occupancy changes.
+
+Block exhaustion preempts the YOUNGEST active request (its blocks free
+immediately; it re-queues at the FRONT and later re-prefills from
+prompt + tokens-produced-so-far, which under greedy decoding continues
+the exact sequence).  A request that could never fit the pool at all is
+refused at submit().
+
+Telemetry: batch-occupancy / pool-utilization / queue-depth /
+eviction-rate gauges (registered in telemetry/schema.GAUGES), admission/
+eviction/preemption/token counters, TTFT + inter-token latency
+histograms, and a per-request `request` record into the JSONL metrics
+stream on finish.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Deque, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt2 import resolved_cache_dtype
+from ..models.sampling import sample_logits
+from .pool import SCRATCH_BLOCK, PagedKVPool, page_ref
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine knobs.  `num_blocks` * `block_tokens` is the pool's total
+    token capacity shared by every concurrent request; `max_active` is
+    the compiled decode step's slot count (occupancy ceiling)."""
+
+    max_active: int = 4
+    num_blocks: int = 32
+    block_tokens: int = 16
+    # paged-pool cache compression: None (rest at the model's
+    # resolved_cache_dtype) | "int8" | "fp8" — blockwise-absmax per head
+    # vector, scales per (block, token, layer, head); serving/pool.py
+    quant: Optional[str] = None
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    # sampling stops at this token when set (the token itself is kept,
+    # so outputs stay comparable with fixed-length `generate` prefixes)
+    eos_id: Optional[int] = None
+    seed: int = 0
+    # per-request length ceiling (prompt + generated), default the model
+    # context.  This SIZES THE COMPILED STEP: block tables are
+    # max_seq_tokens/block_tokens wide and each decode gathers that many
+    # cache positions per slot, so a serving tier whose traffic is
+    # bounded well under block_size should say so — a 256-context model
+    # serving <=40-token requests would otherwise pay a 256-position
+    # panel (6x the attention read) every token
+    max_seq_tokens: Optional[int] = None
+
+
+class Request:
+    """One generation request through its lifecycle:
+    queued -> active -> done (possibly bouncing back to queued on
+    preemption).  Wall-clock latency marks use time.monotonic()."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int):
+        self.id = next(Request._ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens: List[int] = []  # generated (includes eos when hit)
+        self.state = "queued"
+        self.finish_reason: Optional[str] = None
+        self.preemptions = 0
+        now = time.monotonic()
+        self.t_arrival = now
+        self.t_admitted: Optional[float] = None  # first admission
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.active_s = 0.0  # completed active windows (preemptions)
+        self.token_lat: List[float] = []  # per-token completion gaps
+
+    @property
+    def done(self) -> bool:
+        return self.state == "done"
+
+
+class _Slot:
+    """An active request's device-side coordinates: its block table and
+    current cache length (== the next write position)."""
+
+    def __init__(self, req: Request, table: List[int], pos: int,
+                 last_token: int, admitted_at: float):
+        self.req = req
+        self.table = table
+        self.pos = pos
+        self.last = last_token
+        self.admitted_at = admitted_at
+
+
+class ServingEngine:
+    """Continuous-batching inference engine over one model + params."""
+
+    def __init__(self, model, params, config: ServeConfig = ServeConfig(),
+                 *, telemetry=None, logger=None):
+        if not getattr(model, "paged_decode_capable", False):
+            raise ValueError(
+                f"{type(model).__name__} does not support the paged "
+                "decode step (paged_decode_capable=False) — MoE capacity "
+                "routing cannot batch slots at mixed positions"
+            )
+        c = model.config
+        if c.block_size % config.block_tokens:
+            raise ValueError(
+                f"block_tokens={config.block_tokens} must divide the "
+                f"model context block_size={c.block_size} (prefill "
+                "buckets and block tables are block-multiples)"
+            )
+        if config.max_active < 1:
+            raise ValueError("max_active must be >= 1")
+        self.model = model
+        self.params = params
+        self.config = config
+        self.telemetry = telemetry
+        self.logger = logger
+        self.max_seq = config.max_seq_tokens or c.block_size
+        if not 1 <= self.max_seq <= c.block_size:
+            raise ValueError(
+                f"max_seq_tokens={config.max_seq_tokens} must be in "
+                f"[1, block_size={c.block_size}]"
+            )
+        kv_heads = getattr(c, "kv_heads", c.n_head)
+        self.pool = PagedKVPool(
+            n_layer=c.n_layer, kv_heads=kv_heads, head_dim=c.head_dim,
+            num_blocks=config.num_blocks,
+            block_tokens=config.block_tokens,
+            dtype=resolved_cache_dtype(c), quant=config.quant,
+        )
+        # one block table row per slot, wide enough for a max_seq
+        # request; unused entries point at scratch
+        self.max_blocks_per_req = -(-self.max_seq // config.block_tokens)
+        self._slots: List[Optional[_Slot]] = [None] * config.max_active
+        self._queue: Deque[Request] = deque()
+        self._key = jax.random.PRNGKey(config.seed)
+        self._ticks = 0
+        self._evictions = 0
+        self.last_logits = None  # (S, V) f32 of the last decode tick
+
+        bt = config.block_tokens
+        temp, top_k = config.temperature, config.top_k
+
+        def decode_step(params, stacked, view, tokens, pos, tables, key):
+            x = model._embed_decode(params, tokens, pos)
+            page = page_ref(tables, pos, bt)
+            x, view = model.paged_decode(stacked, x, view, page)
+            logits = model.head(params, x)[:, 0]
+            nxt = sample_logits(logits, key, temp, top_k)
+            return nxt, logits, view
+
+        def prefill_step(params, stacked, prompt, last_pos, block_ids,
+                         view, key):
+            logits, view = model.paged_prefill(
+                params, prompt, last_pos, block_ids, view, bt,
+                stacked=stacked,
+            )
+            nxt = sample_logits(logits, key, temp, top_k)
+            return nxt, view
+
+        # the pool view is DONATED through both programs: each step
+        # aliases the pool buffers instead of copying the whole pool
+        self._decode_fn = jax.jit(decode_step, donate_argnums=(2,))
+        self._prefill_fn = jax.jit(prefill_step, donate_argnums=(5,))
+        # "h.*" compute-dtype cast once — params are frozen while serving
+        self._stacked = jax.jit(model.stacked_compute_params)(params)
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: int) -> Request:
+        """Queue one request; returns its handle (tokens accumulate on
+        it as ticks produce them)."""
+        c = self.model.config
+        if len(prompt) < 1 or max_new_tokens < 1:
+            raise ValueError("need a non-empty prompt and >= 1 new token")
+        total = len(prompt) + max_new_tokens
+        if total > self.max_seq:
+            raise ValueError(
+                f"prompt {len(prompt)} + new {max_new_tokens} tokens > "
+                + (f"max_seq_tokens {self.max_seq}"
+                   if self.max_seq < c.block_size
+                   else f"block_size {c.block_size}")
+            )
+        worst = -(-total // self.config.block_tokens)
+        if worst > self.pool.num_usable:
+            raise ValueError(
+                f"request needs up to {worst} blocks but the pool has "
+                f"{self.pool.num_usable} — raise num_blocks or shrink "
+                "the request"
+            )
+        req = Request(prompt, max_new_tokens)
+        self._queue.append(req)
+        self._count("serve_submitted")
+        return req
+
+    def tick(self) -> int:
+        """One scheduler step: admit -> grow/preempt -> one decode step
+        for every active slot -> evict finished.  Returns the number of
+        tokens produced (prefill first-tokens included)."""
+        # growth first: existing slots claim the blocks their next write
+        # needs BEFORE admission can take them — the other order lets a
+        # fresh admission strand a grower, whose preempt-youngest victim
+        # is then the just-prefilled request (a full prefill thrown away
+        # per block boundary while the pool is tight)
+        self._grow()
+        produced = self._admit()
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None]
+        if active:
+            S = self.config.max_active
+            tokens = np.zeros((S,), np.int32)
+            pos = np.zeros((S,), np.int32)
+            tables = np.full((S, self.max_blocks_per_req), SCRATCH_BLOCK,
+                             np.int32)
+            for i, s in active:
+                tokens[i] = s.last
+                pos[i] = s.pos
+                tables[i, :len(s.table)] = s.table
+            nxt, logits, view = self._decode_fn(
+                self.params, self._stacked, self.pool.view,
+                tokens, pos, tables, self._next_key(),
+            )
+            self.pool.view = view
+            self.last_logits = logits
+            nxt = np.asarray(nxt)
+            tnow = time.monotonic()
+            for i, s in active:
+                t = int(nxt[i])
+                s.pos += 1
+                s.last = t
+                self._append_token(s.req, t, tnow)
+                produced += 1
+                if self._finished(s.req):
+                    self._finish(i, s)
+        self._ticks += 1
+        self._update_gauges()
+        return produced
+
+    def drain(self, max_ticks: Optional[int] = None) -> int:
+        """Tick until every submitted request is done; returns total
+        tokens produced.  `max_ticks` bounds runaway loops in tests."""
+        total = 0
+        ticks = 0
+        while self._queue or any(s is not None for s in self._slots):
+            total += self.tick()
+            ticks += 1
+            if max_ticks is not None and ticks > max_ticks:
+                raise RuntimeError(
+                    f"drain exceeded {max_ticks} ticks with "
+                    f"{len(self._queue)} queued"
+                )
+        return total
+
+    @property
+    def n_active(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def active_block_tables(self) -> dict:
+        """{request id: list of physical block ids} for every active
+        slot — what the pool-accounting acceptance sums against
+        pool.blocks_in_use at each tick."""
+        return {s.req.id: list(s.table)
+                for s in self._slots if s is not None}
+
+    def describe(self) -> str:
+        q = self.config.quant or str(jnp.dtype(self.pool.view.k.dtype))
+        return (
+            f"serving(max_active={self.config.max_active}, "
+            f"blocks={self.pool.num_usable}x"
+            f"{self.config.block_tokens}, cache={q})"
+        )
+
+    # -- scheduler internals ------------------------------------------------
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _bucket(self, p: int) -> int:
+        """Prefill pad length: the smallest power-of-two multiple of
+        block_tokens >= p (compiled prefill shapes stay O(log T))."""
+        bt = self.config.block_tokens
+        nb = -(-p // bt)
+        b = 1
+        while b < nb:
+            b *= 2
+        return min(b * bt, self.model.config.block_size)
+
+    def _admit(self) -> int:
+        """FIFO admission: prefill queued requests into free slots while
+        the pool can hold their prompts.  Head-of-line blocking is
+        deliberate — skipping ahead would starve long prompts."""
+        produced = 0
+        while self._queue:
+            try:
+                slot_i = self._slots.index(None)
+            except ValueError:
+                break
+            req = self._queue[0]
+            prompt_now = req.prompt + req.tokens  # preemption continuation
+            p = len(prompt_now)
+            bt = self.config.block_tokens
+            # blocks for the prompt AND its first decode write (position
+            # p): same count as ceil(p/bt) except when p lands exactly
+            # on a block boundary — without the extra block that first
+            # decode write would land in the scratch block (lost K/V),
+            # or need a _grow after admission that can preempt the
+            # admission itself
+            ids = self.pool.alloc(p // bt + 1)
+            if ids is None:
+                break
+            self._queue.popleft()
+            t_adm = time.monotonic()
+            if req.t_admitted is None:
+                req.t_admitted = t_adm
+            bucket = self._bucket(p)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p] = prompt_now
+            block_ids = np.full((bucket // bt,), SCRATCH_BLOCK, np.int32)
+            # the prefill panel only spans the bucket; the +1 decode
+            # block can lie past it (boundary p == bucket) — it is
+            # reached through the slot table, not the prefill scatter
+            k = min(len(ids), bucket // bt)
+            block_ids[:k] = ids[:k]
+            nxt, view = self._prefill_fn(
+                self.params, self._stacked, padded, p - 1, block_ids,
+                self.pool.view, self._next_key(),
+            )
+            self.pool.view = view
+            tok = int(np.asarray(nxt)[0])
+            slot = _Slot(req, table=ids, pos=p, last_token=tok,
+                         admitted_at=t_adm)
+            self._slots[slot_i] = slot
+            req.state = "active"
+            self._count("serve_admissions")
+            self._append_token(req, tok, time.monotonic())
+            produced += 1
+            if self._finished(req):
+                self._finish(slot_i, slot)
+        return produced
+
+    def _grow(self) -> None:
+        """Allocate the next block for any slot whose write position
+        crossed a block boundary; on exhaustion, preempt the youngest
+        active request until the grower fits (or is itself preempted)."""
+        for i, slot in enumerate(self._slots):
+            if slot is None or self._slots[i] is not slot:
+                continue
+            while (self._slots[i] is slot
+                   and len(slot.table) < slot.pos
+                   // self.config.block_tokens + 1):
+                ids = self.pool.alloc(1)
+                if ids is not None:
+                    slot.table.extend(ids)
+                    continue
+                victim_i, victim = max(
+                    ((j, s) for j, s in enumerate(self._slots)
+                     if s is not None),
+                    key=lambda js: js[1].admitted_at,
+                )
+                self._preempt(victim_i, victim)
+
+    def _preempt(self, i: int, slot: _Slot) -> None:
+        req = slot.req
+        self.pool.free_blocks(slot.table)
+        self._slots[i] = None
+        req.state = "queued"
+        req.active_s += time.monotonic() - slot.admitted_at
+        req.preemptions += 1
+        # front of the queue: it resumes (re-prefilling prompt + tokens
+        # so far — greedy-exact continuation) as soon as blocks free up
+        self._queue.appendleft(req)
+        self._count("serve_preemptions")
+
+    def _finished(self, req: Request) -> bool:
+        if len(req.tokens) >= req.max_new_tokens:
+            req.finish_reason = req.finish_reason or "length"
+            return True
+        eos = self.config.eos_id
+        if eos is not None and req.tokens and req.tokens[-1] == eos:
+            req.finish_reason = "eos"
+            return True
+        return False
+
+    def _finish(self, i: int, slot: _Slot) -> None:
+        req = slot.req
+        self.pool.free_blocks(slot.table)
+        self._slots[i] = None
+        req.state = "done"
+        req.t_done = time.monotonic()
+        self._evictions += 1
+        self._count("serve_evictions")
+        if self.logger is not None:
+            self.logger.log_meta(
+                kind="request",
+                request_id=req.id,
+                prompt_tokens=len(req.prompt),
+                new_tokens=len(req.tokens),
+                queue_s=round(req.t_admitted - req.t_arrival, 6),
+                ttft_s=round(req.t_first - req.t_arrival, 6),
+                # rate over the ACTIVE windows only (each admission ->
+                # preemption/done: prefill + decode), not the request
+                # lifetime — queue waits (initial AND re-queued after
+                # preemption) are reported by queue_s/preemptions, and
+                # folding them in here would collapse this field into a
+                # duplicate of overall latency
+                decode_tokens_per_s=round(
+                    len(req.tokens)
+                    / max(req.active_s
+                          + (req.t_done - slot.admitted_at), 1e-9), 3),
+                preemptions=req.preemptions,
+                finish=req.finish_reason or "length",
+            )
+
+    def _append_token(self, req: Request, tok: int, tnow: float) -> None:
+        # per-token latency = gap since the previous token's completion
+        # (arrival for the first — i.e. the first gap IS the TTFT)
+        last_t = getattr(req, "_t_last", req.t_arrival)
+        req.tokens.append(tok)
+        req.token_lat.append(tnow - last_t)
+        req._t_last = tnow
+        if req.t_first is None:
+            req.t_first = tnow
+            if self.telemetry is not None:
+                self.telemetry.histogram("serve_ttft_s").observe(
+                    tnow - req.t_arrival)
+        elif self.telemetry is not None:
+            self.telemetry.histogram("serve_token_latency_s").observe(
+                req.token_lat[-1])
+        self._count("serve_tokens")
+
+    def _count(self, name: str) -> None:
+        if self.telemetry is not None:
+            self.telemetry.counter(name).inc()
+
+    def _update_gauges(self) -> None:
+        if self.telemetry is None:
+            return
+        t = self.telemetry
+        t.gauge("serve_batch_occupancy",
+                self.n_active / self.config.max_active)
+        t.gauge("serve_pool_utilization",
+                self.pool.blocks_in_use / self.pool.num_usable)
+        t.gauge("serve_queue_depth", float(len(self._queue)))
+        t.gauge("serve_eviction_rate",
+                self._evictions / max(1, self._ticks))

@@ -1,0 +1,261 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Paged KV cache: one preallocated HBM pool, per-request block tables.
+
+The contiguous decode cache (`GPT2Model._prefill`) allocates
+(L, B, Hkv, T_max, Dh) per generate() call — every request pays for its
+MAXIMUM length up front, and concurrent requests of different lengths
+cannot share the allocation.  Serving traffic needs the opposite: the
+pool here is ONE (num_blocks, block_tokens, L, KVH, Dh) K/V pair sized
+for the whole engine, carved into fixed `block_tokens`-token blocks.  A
+request owns just the blocks its current length needs (a host-side block
+table of physical block ids); a finished request's blocks return to the
+free list and the next admission reuses them.  On TPU this is the
+decode-throughput design point (the Gemma serving comparison, PAPERS.md
+arXiv:2605.25645): HBM stays densely packed with live cache, so batch
+occupancy — not per-request padding — bounds tokens/s.
+
+Physical block 0 is SCRATCH: never allocated, it absorbs the writes of
+invalid slots and bucket-padding positions so the compiled step stays
+shape-stable without branching.  Scratch contents are garbage by design;
+every read path masks by true position before the softmax.
+
+Quantized cache blocks (`quant="int8" | "fp8"`) rest the pool at 1
+byte/element, reusing the blockwise-absmax codec from `parallel/comm.py`
+(the grad_comm PR's machinery) with the codec block = one (Dh,) head
+vector and the f32 scale stored per (block, token, layer, head) — the
+place a per-vector scale gets to live that the contiguous in-scan cache
+never had.  Dequantization happens at attention time on the gathered
+panel; `_decode_attention` then accumulates in f32 as always.
+
+Everything jit-traceable is a pure function over `KVPoolView` (a pytree
+riding the decode scan's carry); `PagedKVPool` is the host-side owner:
+device arrays + free list + exact accounting.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+# the never-allocated block absorbing invalid-slot / padding writes
+SCRATCH_BLOCK = 0
+
+KV_QUANT_MODES = (None, "int8", "fp8")
+_QDTYPE = {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn}
+
+
+class KVPoolView(NamedTuple):
+    """The pool's device arrays, as traced through the compiled steps.
+
+    k/v: (num_blocks, block_tokens, L, KVH, Dh) in the resting dtype
+    (resolved_cache_dtype, or int8/e4m3 when quantized); k_scale/v_scale:
+    (num_blocks, block_tokens, L, KVH) f32 per-head-vector absmax scales,
+    None on the unquantized path (None prunes to an empty pytree subtree,
+    so the compiled step never sees the operands)."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: Optional[jax.Array]
+    v_scale: Optional[jax.Array]
+
+
+class PageRef(NamedTuple):
+    """Per-slot cache coordinates for one decode step (loop-invariant
+    across layers): tables (S, max_blocks) physical block ids (unused
+    entries -> SCRATCH_BLOCK), blk/off (S,) this token's write block and
+    in-block offset, pos (S,) each slot's current length (the attention
+    mask bound)."""
+
+    tables: jax.Array
+    blk: jax.Array
+    off: jax.Array
+    pos: jax.Array
+
+
+def page_ref(tables, pos, block_tokens: int) -> PageRef:
+    """Derive the write coordinates once per token, outside the layer
+    scan: position p lands in logical block p // block_tokens at offset
+    p % block_tokens."""
+    j = pos // block_tokens
+    blk = jnp.take_along_axis(tables, j[:, None], axis=1)[:, 0]
+    return PageRef(tables, blk, off=pos % block_tokens, pos=pos)
+
+
+def quant_mode(view: KVPoolView) -> Optional[str]:
+    """The pool's quantization mode, read off its STATIC dtypes — no
+    extra non-array argument has to thread through jit."""
+    if view.k_scale is None:
+        return None
+    return "int8" if view.k.dtype == jnp.int8 else "fp8"
+
+
+def _quant_vectors(x, mode: str):
+    """(..., Dh) f32-able -> (q same shape, scales (...,)) via the
+    grad-comm blockwise-absmax codec with codec block = the Dh head
+    vector (parallel/comm.quantize_blockwise, round-to-nearest — KV
+    vectors are read many times, so unbiasedness-via-dither buys nothing
+    and costs a PRNG operand)."""
+    from ..parallel.comm import quantize_blockwise
+    dh = x.shape[-1]
+    q, s = quantize_blockwise(
+        x.astype(jnp.float32).reshape(-1), mode, block=dh
+    )
+    return q.reshape(x.shape), s.reshape(x.shape[:-1])
+
+
+def paged_append(view: KVPoolView, k, v, l, page: PageRef) -> KVPoolView:
+    """Write one token's K/V sliver per slot — k/v (S, KVH, Dh) — at
+    (page.blk, page.off, l).  Invalid slots' coordinates point at the
+    scratch block, so the scatter is branch-free."""
+    mode = quant_mode(view)
+    if mode is None:
+        return view._replace(
+            k=view.k.at[page.blk, page.off, l].set(k.astype(view.k.dtype)),
+            v=view.v.at[page.blk, page.off, l].set(v.astype(view.v.dtype)),
+        )
+    qk, sk = _quant_vectors(k, mode)
+    qv, sv = _quant_vectors(v, mode)
+    return KVPoolView(
+        k=view.k.at[page.blk, page.off, l].set(qk),
+        v=view.v.at[page.blk, page.off, l].set(qv),
+        k_scale=view.k_scale.at[page.blk, page.off, l].set(sk),
+        v_scale=view.v_scale.at[page.blk, page.off, l].set(sv),
+    )
+
+
+def paged_panel(view: KVPoolView, l, page: PageRef, out_dtype):
+    """Gather layer l's K/V panels through the block tables:
+    (S, KVH, max_blocks * block_tokens, Dh) per side, ready for
+    `_decode_attention`.  Unquantized panels stay in the pool's resting
+    dtype (the attention consumes it directly); quantized panels
+    dequantize to `out_dtype` here — the 1-byte blocks are what crossed
+    HBM, the dequantized panel is attention-local."""
+    mode = quant_mode(view)
+
+    def panel(pool, scale):
+        pl = jax.lax.dynamic_index_in_dim(pool, l, 2, keepdims=False)
+        g = pl[page.tables]  # (S, Bmax, bt, KVH, Dh)
+        s, bmax, bt, kvh, dh = g.shape
+        g = g.reshape(s, bmax * bt, kvh, dh).swapaxes(1, 2)
+        if mode is None:
+            return g
+        sl = jax.lax.dynamic_index_in_dim(scale, l, 2, keepdims=False)
+        sg = sl[page.tables].reshape(s, bmax * bt, kvh).swapaxes(1, 2)
+        return (g.astype(jnp.float32) * sg[..., None]).astype(out_dtype)
+
+    return panel(view.k, view.k_scale), panel(view.v, view.v_scale)
+
+
+def paged_scatter(view: KVPoolView, ks, vs, block_ids,
+                  block_tokens: int) -> KVPoolView:
+    """Scatter a prefill's full-prompt K/V — ks/vs (L, 1, KVH, P, Dh)
+    from the `return_kv` forward hook — into the pool blocks `block_ids`
+    ((P / block_tokens,) physical ids; bucket-padding tail entries point
+    at scratch).  P is the bucket length, always a block multiple."""
+    mode = quant_mode(view)
+
+    def prep(a):
+        L, b, kvh, p, dh = a.shape  # b == 1: prefill is per-request
+        a = a[:, 0].transpose(2, 0, 1, 3)  # (P, L, KVH, Dh)
+        return a.reshape(p // block_tokens, block_tokens, L, kvh, dh)
+
+    kb, vb = prep(ks), prep(vs)
+    if mode is None:
+        return view._replace(
+            k=view.k.at[block_ids].set(kb.astype(view.k.dtype)),
+            v=view.v.at[block_ids].set(vb.astype(view.v.dtype)),
+        )
+    qk, sk = _quant_vectors(kb, mode)
+    qv, sv = _quant_vectors(vb, mode)
+    return KVPoolView(
+        k=view.k.at[block_ids].set(qk),
+        v=view.v.at[block_ids].set(qv),
+        k_scale=view.k_scale.at[block_ids].set(sk),
+        v_scale=view.v_scale.at[block_ids].set(sv),
+    )
+
+
+class PagedKVPool:
+    """Host-side pool owner: the device arrays plus exact block
+    accounting.  `num_blocks` is the USABLE count — one extra scratch
+    block is allocated on top and never handed out."""
+
+    def __init__(self, *, n_layer: int, kv_heads: int, head_dim: int,
+                 num_blocks: int, block_tokens: int, dtype,
+                 quant: Optional[str] = None):
+        if quant not in KV_QUANT_MODES:
+            raise ValueError(
+                f"KV-cache quant must be one of {KV_QUANT_MODES}, "
+                f"got {quant!r}"
+            )
+        if num_blocks < 1 or block_tokens < 1:
+            raise ValueError("num_blocks and block_tokens must be >= 1")
+        self.num_usable = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        self.quant = quant
+        total = self.num_usable + 1  # + scratch
+        shape = (total, block_tokens, n_layer, kv_heads, head_dim)
+        rest = _QDTYPE.get(quant, dtype)
+
+        def scale():
+            # distinct arrays per side: the view is DONATED through the
+            # compiled steps, and two fields aliasing one zeros buffer
+            # would be a double donation
+            return jnp.zeros(shape[:-1], jnp.float32) if quant else None
+
+        self.view = KVPoolView(
+            k=jnp.zeros(shape, rest), v=jnp.zeros(shape, rest),
+            k_scale=scale(), v_scale=scale(),
+        )
+        # pop() hands out ascending ids from 1; frees push back LIFO —
+        # both deterministic, which the realloc-determinism test pins
+        self._free: List[int] = list(range(total - 1, 0, -1))
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_usable - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n physical block ids, or None WITHOUT allocating when fewer
+        than n are free (admission is all-or-nothing)."""
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free_blocks(self, ids: List[int]) -> None:
+        for b in ids:
+            if not 1 <= b <= self.num_usable:
+                raise ValueError(f"freeing invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(ids)
+
+    def kv_bytes(self) -> dict:
+        """The pool's resting HBM footprint, FROM the device arrays'
+        dtypes/shapes (what the quantization acceptance asserts against,
+        not a model): K+V block bytes, scale bytes, and the per-element
+        width."""
+        k = self.view.k
+        blocks = 2 * k.size * jnp.dtype(k.dtype).itemsize
+        scales = (
+            2 * self.view.k_scale.size
+            * jnp.dtype(self.view.k_scale.dtype).itemsize
+            if self.view.k_scale is not None else 0
+        )
+        return {
+            "kv_block_bytes": int(blocks),
+            "scale_bytes": int(scales),
+            "total_bytes": int(blocks + scales),
+            "dtype": str(jnp.dtype(k.dtype)),
+            "itemsize": int(jnp.dtype(k.dtype).itemsize),
+        }

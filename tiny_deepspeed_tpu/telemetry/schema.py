@@ -35,8 +35,10 @@ _NUM = (int, float)
 #   2: + trace / flight / straggler meta kinds, schema_version stamp,
 #      per-layer health fields
 #   3: + resume / fault meta kinds (resilience subsystem: elastic resume
-#      reports, chaos fault-injection log) and checkpoint gauges (this PR)
-SCHEMA_VERSION = 3
+#      reports, chaos fault-injection log) and checkpoint gauges
+#   4: + request meta kind (serving tier per-request latency records)
+#      and the serve_* gauges (this PR)
+SCHEMA_VERSION = 4
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -79,6 +81,9 @@ META_KINDS = (
     # chaos fault-injection log: one record per injected fault
     # (resilience/chaos.py), and straggler-rebalance mitigation events
     "fault",
+    # serving tier: one record per FINISHED request — queueing, TTFT and
+    # decode-rate latency breakdown (serving/engine.py::_finish)
+    "request",
 )
 
 META_FIELDS: Dict[str, tuple] = {
@@ -146,6 +151,15 @@ META_FIELDS: Dict[str, tuple] = {
     "attempts": int,
     "action": str,
     "shares": list,
+    # request record (serving tier, one per finished request)
+    "request_id": int,
+    "prompt_tokens": int,
+    "new_tokens": int,
+    "queue_s": _NUM,           # arrival -> first admission
+    "ttft_s": _NUM,            # arrival -> first token
+    "decode_tokens_per_s": _NUM,
+    "preemptions": int,
+    "finish": str,             # "length" | "eos"
 }
 
 
@@ -276,4 +290,14 @@ GAUGES: Dict[str, str] = {
     "checkpoint_overlap_steps": "training steps whose compute ran while "
                                 "an async checkpoint save was in flight "
                                 "(the steps hidden behind I/O)",
+    "serve_batch_occupancy": "active decode slots / max_active at the "
+                             "last scheduler tick (serving tier) — the "
+                             "quantity continuous batching exists to "
+                             "keep high",
+    "serve_pool_utilization": "allocated paged-KV blocks / usable pool "
+                              "blocks at the last tick",
+    "serve_queue_depth": "requests waiting for admission at the last "
+                         "tick",
+    "serve_eviction_rate": "finished-request evictions per scheduler "
+                           "tick, cumulative",
 }
