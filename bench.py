@@ -801,6 +801,9 @@ def run_serve(model_name: str, b=None, t=None):
             "occupancy": res["mean_occupancy"],
             "pool_utilization": res["mean_pool_utilization"],
             "pool_kv_bytes": eng.pool.kv_bytes()["kv_block_bytes"],
+            # terminal outcomes (all "ok" on this fault-free record;
+            # anything else means the bench itself mis-served)
+            "status_counts": res["status_counts"],
         },
     }
 

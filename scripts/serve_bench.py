@@ -16,10 +16,17 @@ per-token latency — the serving headline the ROADMAP asks for.
     # quantized KV blocks:
     python scripts/serve_bench.py --model tiny --cpu --kv-quant int8
 
+    # goodput under faults: slot-poison + tick-delay chaos, A/B'd
+    # against the same trace fault-free (--chaos runs both passes):
+    python scripts/serve_bench.py --model tiny --cpu --requests 12 \
+        --closed-loop --chaos "nan@6,nan@7,delay@10" --deadline 30
+
 Prints a human summary plus ONE machine-readable JSON line (the same
 shape bench.py's BENCH_SERVE record embeds in `extra`); --jsonl writes
 the per-request `request` records + telemetry summary through the
-standard metrics schema (render with scripts/report_run.py)."""
+standard metrics schema (render with scripts/report_run.py).  With
+--chaos the JSON carries both passes plus the terminal-status counts
+(ok/shed/expired/failed) and p99 TTFT with and without faults."""
 
 import argparse
 import json
@@ -53,6 +60,26 @@ def main(argv=None) -> int:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--deadline", type=float, default=None, metavar="S",
+                   help="per-request completion SLO in seconds; the "
+                        "engine sheds unmeetable queued requests and "
+                        "expires active ones that blow it")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="admission watermark: submissions beyond this "
+                        "queue depth are shed at the door")
+    p.add_argument("--shed-pool-util", type=float, default=None,
+                   help="pool-pressure watermark in [0,1]: shed "
+                        "submissions while the paged pool is this full "
+                        "with a backlog")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="tick-fault spec, e.g. 'nan@6,delay@10,nan%%0.02'"
+                        " (kinds: nan, delay, prefill, journal_kill); "
+                        "runs the SAME trace fault-free first and "
+                        "reports the goodput A/B")
+    p.add_argument("--chaos-delay-s", type=float, default=0.25,
+                   help="tick-delay fault duration")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="append the crash-recovery request journal here")
     p.add_argument("--serial", action="store_true",
                    help="also run the one-at-a-time generate() baseline "
                         "on the same trace and report the ratio")
@@ -79,7 +106,7 @@ def main(argv=None) -> int:
         args.requests, rate_rps=args.rate,
         prompt_lens=prompt_lens,
         max_new_tokens=args.max_new_tokens, vocab_size=cfg.vocab_size,
-        seed=args.seed,
+        seed=args.seed, deadline_s=args.deadline,
     )
 
     tel = Telemetry()
@@ -100,31 +127,44 @@ def main(argv=None) -> int:
         -(-(max(prompt_lens) + args.max_new_tokens) // bt) * bt,
     )
 
-    eng = ServingEngine(
-        model, params,
-        ServeConfig(
-            max_active=args.max_active, num_blocks=args.num_blocks,
-            block_tokens=bt, quant=args.kv_quant,
-            temperature=args.temperature, top_k=args.top_k,
-            seed=args.seed, max_seq_tokens=max_seq,
-        ),
+    serve_cfg = ServeConfig(
+        max_active=args.max_active, num_blocks=args.num_blocks,
+        block_tokens=bt, quant=args.kv_quant,
+        temperature=args.temperature, top_k=args.top_k,
+        seed=args.seed, max_seq_tokens=max_seq,
+        max_queue=args.max_queue, shed_pool_util=args.shed_pool_util,
     )
+    realtime = not args.closed_loop and args.rate is not None
+
+    if args.chaos and "journal_kill" in args.chaos and not args.journal:
+        p.error("--chaos journal_kill@N needs --journal PATH (the kill "
+                "fires inside the journal's commit, and recovery "
+                "replays it)")
+
     # warm run on the SAME engine (each engine owns fresh jit closures,
     # so warming a throwaway one buys nothing): one request per DISTINCT
     # prompt length covers every power-of-two prefill bucket, closed-loop
     # covers the decode step — the measured pass then reports serving
-    # throughput, not XLA compile time.  Telemetry/logger attach after,
-    # so warm requests pollute neither counters nor the JSONL.
+    # throughput, not XLA compile time.  Telemetry/logger/journal attach
+    # after, so warm requests pollute neither counters, the JSONL, nor
+    # the crash-recovery write-ahead log.
+    from tiny_deepspeed_tpu.serving import RequestJournal
     from tiny_deepspeed_tpu.serving.driver import Arrival
-    warm = [
-        Arrival(0.0, [0] * plen, min(2, args.max_new_tokens))
-        for plen in sorted(set(prompt_lens))
-    ]
-    run_trace(eng, warm, realtime=False)
-    eng.telemetry, eng.logger = tel, logger
 
-    res = run_trace(eng, trace, realtime=not args.closed_loop
-                    and args.rate is not None)
+    def warmed_engine():
+        e = ServingEngine(model, params, serve_cfg)
+        warm = [
+            Arrival(0.0, [0] * plen, min(2, args.max_new_tokens))
+            for plen in sorted(set(prompt_lens))
+        ]
+        run_trace(e, warm, realtime=False)
+        if args.journal:
+            e.journal = RequestJournal(args.journal)
+        return e
+
+    eng = warmed_engine()
+    eng.telemetry, eng.logger = tel, logger
+    res = run_trace(eng, trace, realtime=realtime)
     res.pop("outputs")
     res.pop("requests")
 
@@ -134,7 +174,11 @@ def main(argv=None) -> int:
         "rate_rps": args.rate,
         "max_active": args.max_active,
         "kv_quant": args.kv_quant,
+        "deadline_s": args.deadline,
         "tokens_per_s": res["tokens_per_s"],
+        "ok_tokens_per_s": res["ok_tokens_per_s"],
+        "status_counts": res["status_counts"],
+        "restarts": res["restarts"],
         "token_latency": res["token_latency"],
         "ttft": res["ttft"],
         "mean_occupancy": res["mean_occupancy"],
@@ -143,6 +187,54 @@ def main(argv=None) -> int:
         "preemptions": res["preemptions"],
         "pool": eng.pool.kv_bytes(),
     }
+
+    if args.chaos:
+        # goodput under faults, A/B on the SAME trace: the clean pass
+        # above is the baseline; this pass injects the tick faults
+        from tiny_deepspeed_tpu.resilience import (
+            ChaosServingEngine, parse_serving_chaos,
+        )
+        from tiny_deepspeed_tpu.serving import ServingKilled
+        chaos = parse_serving_chaos(args.chaos, seed=args.seed,
+                                    delay_s=args.chaos_delay_s)
+        ceng = ChaosServingEngine(warmed_engine(), chaos)
+        ceng.engine.telemetry, ceng.engine.logger = tel, logger
+        try:
+            cres = run_trace(ceng, trace, realtime=realtime)
+        except ServingKilled:
+            # the journal_kill fault "killed" the engine mid-commit;
+            # demonstrate the recovery recipe end-to-end: a fresh
+            # engine replays the journal and finishes the in-flight
+            # requests (arrivals not yet submitted died with the
+            # process, exactly as a real crash loses them)
+            reng = warmed_engine()
+            rec = reng.recover()
+            reng.drain()
+            cres = None
+            summary["chaos"] = {
+                "spec": args.chaos,
+                "journal_killed": True,
+                "recovered": len(rec),
+                "recovered_ok": sum(1 for r in rec
+                                    if r.status == "ok"),
+            }
+        n_faults = len(chaos.injected)
+        if logger is not None:
+            chaos.log_faults(logger)
+        if cres is not None:
+            summary["chaos"] = {
+                "spec": args.chaos,
+                "faults_injected": n_faults,
+                "tokens_per_s": cres["tokens_per_s"],
+                "ok_tokens_per_s": cres["ok_tokens_per_s"],
+                "status_counts": cres["status_counts"],
+                "restarts": cres["restarts"],
+                "ttft_p99_ms": cres["ttft"]["p99_ms"],
+                "ttft_p99_ms_clean": res["ttft"]["p99_ms"],
+                "goodput_frac": round(
+                    cres["ok_tokens_per_s"]
+                    / max(res["ok_tokens_per_s"], 1e-9), 3),
+            }
     if args.serial:
         from tiny_deepspeed_tpu.serving.driver import run_serial
         ser = run_serial(model, params, trace,
@@ -151,11 +243,32 @@ def main(argv=None) -> int:
         summary["vs_serial"] = round(
             res["tokens_per_s"] / max(ser["tokens_per_s"], 1e-9), 3)
 
+    sc = res["status_counts"]
     print(f"served {args.requests} requests, {res['tokens']} tokens in "
           f"{res['wall_s']}s -> {res['tokens_per_s']} tok/s "
           f"(occupancy {res['mean_occupancy']:.2f}, "
           f"p50 {res['token_latency']['p50_ms']}ms / "
           f"p99 {res['token_latency']['p99_ms']}ms per token)")
+    print(f"outcomes: ok {sc['ok']} / shed {sc['shed']} / "
+          f"expired {sc['expired']} / failed {sc['failed']} "
+          f"(goodput {res['ok_tokens_per_s']} tok/s)")
+    if args.chaos:
+        ch = summary["chaos"]
+        if ch.get("journal_killed"):
+            print(f"chaos [{ch['spec']}]: engine killed between "
+                  f"journal append and commit; recovered "
+                  f"{ch['recovered']} in-flight request(s) from "
+                  f"{args.journal} -> {ch['recovered_ok']} ok")
+        else:
+            cc = ch["status_counts"]
+            print(f"chaos [{ch['spec']}]: {ch['faults_injected']} "
+                  f"faults, {ch['restarts']} restarts -> ok {cc['ok']} "
+                  f"/ shed {cc['shed']} / expired {cc['expired']} / "
+                  f"failed {cc['failed']}; goodput "
+                  f"{ch['ok_tokens_per_s']} tok/s "
+                  f"({ch['goodput_frac']}x clean), p99 TTFT "
+                  f"{ch['ttft_p99_ms']}ms vs {ch['ttft_p99_ms_clean']}"
+                  "ms clean")
     if args.serial:
         print(f"serial generate() baseline: "
               f"{summary['serial_tokens_per_s']} tok/s -> "

@@ -152,3 +152,40 @@ def test_gauge_names_documented_in_schema():
         f"telemetry/schema.GAUGES: {undocumented} — add them there "
         "(one line each) so the metrics surface stays self-describing"
     )
+
+
+def test_serving_robustness_schema_v5_names():
+    """The serving fault surface is part of the schema contract: the
+    v5 gauges must stay documented AND registered by the engine (a
+    rename on either side desynchronizes dashboards), and the
+    terminal-status request-record fields must stay validatable —
+    `report_run.py --check` hard-fails on records carrying them
+    otherwise."""
+    from tiny_deepspeed_tpu.telemetry import schema
+
+    assert schema.SCHEMA_VERSION >= 5
+    v5_gauges = {"serve_shed", "serve_expired", "serve_quarantined",
+                 "serve_restarts"}
+    assert v5_gauges <= set(schema.GAUGES), (
+        v5_gauges - set(schema.GAUGES))
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "serving", "engine.py")) as f:
+        engine_src = f.read()
+    for g in sorted(v5_gauges):
+        assert f'"{g}"' in engine_src, (
+            f"gauge {g} documented in schema but no longer registered "
+            "by serving/engine.py"
+        )
+    for field, ty in (("status", str), ("finish", str),
+                      ("deadline_s", (int, float)), ("slot", int)):
+        assert field in schema.META_FIELDS
+    # a representative terminal record of each status validates
+    for status, finish in (("ok", "length"), ("shed", "shed:queue"),
+                           ("expired", "deadline"),
+                           ("failed", "nonfinite_logits")):
+        errs = schema.validate_record({
+            "kind": "request", "ts": 0.0, "request_id": 1,
+            "prompt_tokens": 4, "new_tokens": 2, "preemptions": 0,
+            "status": status, "finish": finish,
+        })
+        assert not errs, (status, errs)

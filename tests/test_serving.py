@@ -1,7 +1,8 @@
 # Copyright 2026 tiny-deepspeed-tpu authors
 # SPDX-License-Identifier: Apache-2.0
 
-"""Serving tier: paged KV pool, continuous batching, quantized cache.
+"""Serving tier: paged KV pool, continuous batching, quantized cache,
+and the fault-tolerance layer (SLOs, decode-health guard, journal).
 
 Acceptance pins (ISSUE 7):
   * paged decode is token-exact with `GPT2Model.generate` greedy, per
@@ -15,6 +16,20 @@ Acceptance pins (ISSUE 7):
     step's HLO byte-identical (subprocess-pinned, fresh import order);
   * the Poisson soak (slow tier): >= 4 concurrent requests beat the
     same trace served one-at-a-time through `generate`.
+
+Acceptance pins (ISSUE 8, robustness):
+  * terminal statuses are exact and exclusive (ok/shed/expired/failed),
+    each with its JSONL `request` record;
+  * a NaN-poisoned slot is quarantined WITHOUT taking the batch down —
+    neighbors stay token-exact — and every freed block returns to the
+    pool exactly once under a quarantine storm;
+  * the watchdog warm-restarts on K consecutive poisoned ticks or a
+    tick exception, and the re-queued requests continue token-exact;
+  * kill-mid-trace (slow tier): SIGKILL the serving process, recover a
+    fresh engine from the journal, final sequences identical to the
+    uninterrupted run;
+  * temperature > 0 preemption resume is deterministic under the
+    (request seed, position) sampling keys.
 """
 
 import json
@@ -368,13 +383,386 @@ class TestServingTelemetry:
         assert 0 < res["mean_occupancy"] <= 1.0
 
 
+class TestServeSLOs:
+    """Request deadlines + load shedding: every terminal outcome is a
+    distinct status and nothing queues unboundedly."""
+
+    def test_submit_sheds_on_queue_watermark(self, model, params,
+                                             tmp_path):
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.telemetry import schema
+        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
+        path = str(tmp_path / "shed.jsonl")
+        with MetricsLogger(path, stdout=False) as ml:
+            eng = ServingEngine(model, params,
+                                _serve_config(max_queue=2), logger=ml)
+            reqs = [eng.submit(_prompt(s, 7), 4) for s in range(5)]
+        shed = [r for r in reqs if r.status == "shed"]
+        # 5 submitted, 0 active yet, watermark 2: the last 3 shed at the
+        # door with a terminal record, never queued
+        assert len(shed) == 3 and eng.queue_depth == 2
+        assert all(r.done and r.finish_reason == "shed:queue_watermark"
+                   and not r.tokens for r in shed)
+        counts, errs = schema.validate_file(path)
+        assert not errs, errs
+        with open(path) as f:
+            recs = [json.loads(ln) for ln in f]
+        assert [r["status"] for r in recs
+                if r.get("kind") == "request"] == ["shed"] * 3
+
+    def test_submit_sheds_on_pool_pressure(self, model, params):
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(
+            model, params,
+            _serve_config(max_active=1, num_blocks=4,
+                          shed_pool_util=0.5))
+        r0 = eng.submit(_prompt(1, 13), 10)  # holds >= 2/4 blocks
+        eng.tick()
+        r1 = eng.submit(_prompt(2, 7), 4)    # queued (backlog forms)
+        r2 = eng.submit(_prompt(3, 7), 4)    # pool full + backlog: shed
+        assert r1.status is None and r2.status == "shed"
+        assert r2.finish_reason == "shed:pool_watermark"
+        eng.drain(max_ticks=200)
+        assert r0.status == "ok" and r1.status == "ok"
+
+    def test_active_deadline_expiry_evicts(self, model, params):
+        """An active request past its deadline is evicted as `expired`
+        (partial tokens kept, blocks freed); its neighbor without a
+        deadline is untouched and stays token-exact."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params, _serve_config(max_active=2))
+        ra = eng.submit(_prompt(1, 7), 12, deadline_s=60.0)
+        rb = eng.submit(_prompt(2, 7), 12)
+        eng.tick()
+        assert ra.state == "active"
+        ra.t_arrival -= 120.0  # move its deadline into the past
+        eng.tick()
+        _assert_accounting(eng)
+        assert ra.status == "expired" and ra.finish_reason == "deadline"
+        assert 0 < len(ra.tokens) < 12  # partial delivery
+        eng.drain(max_ticks=100)
+        assert rb.status == "ok"
+        np.testing.assert_array_equal(
+            np.asarray(rb.tokens), _ref_tokens(model, params, rb.prompt,
+                                               12),
+            err_msg="neighbor diverged across an expiry eviction",
+        )
+        assert eng.pool.blocks_in_use == 0
+
+    def test_queue_shed_on_unmeetable_deadline(self, model, params):
+        """A queued request whose deadline cannot be met at the
+        measured inter-token rate is shed BEFORE wasting a prefill.
+        The price comes from the engine's decode-wall history, so warm
+        it first; the overdue case needs no history at all."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params, _serve_config(max_active=1))
+        warm = eng.submit(_prompt(1, 7), 8)
+        eng.drain(max_ticks=100)  # 8 decode walls measured
+        assert warm.status == "ok" and eng._gap_p50() is not None
+        holder = eng.submit(_prompt(2, 7), 12)   # occupies the 1 slot
+        eng.tick()
+        # queued behind it: needs 30 tokens but the deadline is one
+        # measured tick wide — unmeetable at any realistic rate
+        tight = eng.submit(_prompt(3, 7), 30,
+                           deadline_s=eng._gap_p50() * 1.0)
+        eng.tick()
+        assert tight.status == "shed"
+        assert tight.finish_reason.startswith("shed:deadline")
+        assert not tight.tokens  # never admitted, no prefill paid
+        eng.drain(max_ticks=200)
+        assert holder.status == "ok"
+
+    def test_drain_max_ticks_truncation(self, model, params):
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params, _serve_config())
+        eng.submit(_prompt(1, 7), 20)
+        with pytest.raises(RuntimeError, match="drain exceeded 2 ticks"):
+            eng.drain(max_ticks=2)
+
+
+class TestDecodeHealthGuard:
+    """Non-finite decode logits: quarantine the slot, keep the batch;
+    watchdog warm restart on persistence."""
+
+    def test_quarantine_storm_exact_pool_accounting(self, model,
+                                                    params):
+        """Poison EVERY active slot in one tick: all quarantined as
+        `failed`, every freed block returns to the free list exactly
+        once (no loss, no double-free), and the engine keeps serving —
+        a fresh request admits onto the reclaimed blocks and is
+        token-exact."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params,
+                            _serve_config(guard_k_restart=3))
+        storm = [eng.submit(_prompt(s, 7), 10) for s in (1, 2, 3)]
+        eng.tick()
+        assert eng.n_active == 3
+        for i in eng.active_slots():
+            eng.poison_slot(i)
+        eng.tick()
+        _assert_accounting(eng)
+        assert [r.status for r in storm] == ["failed"] * 3
+        assert all(r.finish_reason == "nonfinite_logits" for r in storm)
+        free = eng.pool._free
+        assert len(free) == len(set(free)) == eng.pool.num_usable, (
+            "quarantine leaked or double-freed pool blocks"
+        )
+        fresh = eng.submit(_prompt(4, 7), 10)
+        eng.drain(max_ticks=100)
+        assert fresh.status == "ok"
+        np.testing.assert_array_equal(
+            np.asarray(fresh.tokens),
+            _ref_tokens(model, params, fresh.prompt, 10),
+            err_msg="post-storm admission corrupted",
+        )
+        assert eng.restarts == 0  # one poisoned tick < k_restart
+
+    def test_neighbor_survives_quarantine_token_exact(self, model,
+                                                      params):
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params, _serve_config(max_active=2))
+        victim = eng.submit(_prompt(1, 7), 10)
+        neighbor = eng.submit(_prompt(2, 13), 10)
+        eng.tick()
+        eng.poison_slot(eng.active_slots()[0])  # victim admitted first
+        eng.drain(max_ticks=100)
+        assert victim.status == "failed"
+        assert neighbor.status == "ok"
+        np.testing.assert_array_equal(
+            np.asarray(neighbor.tokens),
+            _ref_tokens(model, params, neighbor.prompt, 10),
+            err_msg="neighbor diverged across a quarantine",
+        )
+
+    def test_watchdog_restart_after_consecutive_poison(self, model,
+                                                       params):
+        """k_restart consecutive poisoned ticks trip ONE warm restart;
+        the in-flight survivors re-queue and finish token-exact on the
+        rebuilt pool (same compiled programs)."""
+        from tiny_deepspeed_tpu.resilience import (
+            Chaos, ChaosServingEngine,
+        )
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params,
+                            _serve_config(max_active=2,
+                                          guard_k_restart=2))
+        ce = ChaosServingEngine(eng, Chaos(seed=3,
+                                           tick_nan_steps=(1, 2)))
+        reqs = [ce.submit(_prompt(s, 7), 12) for s in (1, 2, 3)]
+        ce.drain(max_ticks=300)
+        assert eng.restarts == 1
+        statuses = sorted(r.status for r in reqs)
+        assert statuses.count("failed") == 2  # one per poisoned tick
+        survivors = [r for r in reqs if r.status == "ok"]
+        assert survivors, "someone must survive the restart"
+        for r in survivors:
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens),
+                _ref_tokens(model, params, r.prompt, 12),
+                err_msg=f"request {r.id} diverged across warm restart",
+            )
+        _assert_accounting(eng)
+        assert eng.pool.blocks_in_use == 0
+
+    def test_tick_exception_warm_restart(self, model, params):
+        """A chaos-injected prefill failure trips the watchdog: the
+        half-admitted request re-queues and completes token-exact after
+        the restart."""
+        from tiny_deepspeed_tpu.resilience import (
+            Chaos, ChaosServingEngine,
+        )
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params, _serve_config())
+        ce = ChaosServingEngine(eng,
+                                Chaos(seed=4, prefill_raise_steps=(0,)))
+        r = ce.submit(_prompt(5, 7), 8)
+        ce.drain(max_ticks=100)
+        assert eng.restarts == 1 and r.status == "ok"
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens),
+            _ref_tokens(model, params, r.prompt, 8),
+            err_msg="request diverged across a prefill-failure restart",
+        )
+
+    def test_guard_off_propagates_tick_exceptions(self, model, params):
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        eng = ServingEngine(model, params,
+                            _serve_config(health_guard=False))
+        eng.submit(_prompt(1, 7), 4)
+        eng.arm_prefill_exception(RuntimeError("boom"))
+        with pytest.raises(RuntimeError, match="boom"):
+            eng.tick()
+
+
+class TestRequestJournal:
+    """Crash-recoverable request journal + ServingEngine.recover."""
+
+    def test_replay_tolerates_torn_tail_only(self, tmp_path):
+        from tiny_deepspeed_tpu.serving.journal import RequestJournal
+        p = str(tmp_path / "j.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"ev": "submit", "id": 0,
+                                "prompt": [1, 2], "max_new": 4,
+                                "deadline_s": None, "seed": 0}) + "\n")
+            f.write(json.dumps({"ev": "tok", "id": 0,
+                                "toks": [5]}) + "\n")
+            f.write('{"ev": "tok", "id": 0, "to')  # torn by the crash
+        pending, done = RequestJournal.replay(p)
+        assert done == [] and len(pending) == 1
+        assert pending[0]["tokens"] == [5]
+        # the SAME torn line mid-file is corruption, not a crash mark
+        with open(p, "a") as f:
+            f.write("\n" + json.dumps({"ev": "end", "id": 0,
+                                       "status": "ok",
+                                       "finish": "length"}) + "\n")
+        with pytest.raises(ValueError, match="corrupt journal"):
+            RequestJournal.replay(p)
+
+    def test_recover_continues_token_exact(self, model, params,
+                                           tmp_path):
+        """Abandon an engine mid-flight (requests active AND queued);
+        a fresh engine recovers from its journal and every interrupted
+        request finishes with exactly the sequence an uninterrupted run
+        produces."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        jp = str(tmp_path / "journal.jsonl")
+        cfg = _serve_config(max_active=2)
+        engA = ServingEngine(model, params, cfg, journal=jp)
+        specs = [(6, 7, 10), (7, 13, 10), (8, 7, 10)]
+        ra = [engA.submit(_prompt(s, n), new) for s, n, new in specs]
+        for _ in range(4):
+            engA.tick()
+        assert any(r.tokens for r in ra) and not all(r.done for r in ra)
+        engB = ServingEngine(model, params, cfg, journal=jp)
+        rec = engB.recover()
+        assert [r.id for r in rec] == [r.id for r in ra]
+        engB.drain(max_ticks=200)
+        for r, (s, n, new) in zip(rec, specs):
+            assert r.status == "ok"
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens),
+                _ref_tokens(model, params, r.prompt, new),
+                err_msg=f"recovered request {r.id} diverged",
+            )
+
+    def test_recover_closes_eos_finished_request(self, model, params,
+                                                 tmp_path):
+        """A request whose journaled prefix already ends in eos — but
+        whose end line was torn away by the crash — must be CLOSED OUT
+        at recovery, not re-queued: re-admitting it would decode past
+        its eos and diverge from the uninterrupted run."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.serving.journal import RequestJournal
+        jp = str(tmp_path / "journal.jsonl")
+        eos = 42
+        with open(jp, "w") as f:
+            f.write(json.dumps({"ev": "submit", "id": 0,
+                                "prompt": [1, 2, 3], "max_new": 8,
+                                "deadline_s": None, "seed": 0}) + "\n")
+            f.write(json.dumps({"ev": "tok", "id": 0,
+                                "toks": [5, 9, eos]}) + "\n")
+        eng = ServingEngine(model, params,
+                            _serve_config(eos_id=eos), journal=jp)
+        rec = eng.recover()
+        assert rec == [] and eng.queue_depth == 0
+        # the close-out landed an end line: a second replay sees the
+        # request finished, so a crash loop cannot resurrect it either
+        pending, done = RequestJournal.replay(jp)
+        assert pending == [] and done == [0]
+
+    def test_chaos_journal_kill_then_recover(self, model, params,
+                                             tmp_path):
+        """The chaos kill between journal-append and commit loses that
+        tick's token lines; recovery re-decodes them to the same values
+        (greedy continuation is position-keyed, not journal-keyed)."""
+        from tiny_deepspeed_tpu.resilience import (
+            Chaos, ChaosServingEngine,
+        )
+        from tiny_deepspeed_tpu.serving import ServingEngine, ServingKilled
+        jp = str(tmp_path / "journal.jsonl")
+        cfg = _serve_config(max_active=2)
+        eng = ServingEngine(model, params, cfg, journal=jp)
+        ce = ChaosServingEngine(eng, Chaos(seed=5, journal_kill_step=3))
+        reqs = [ce.submit(_prompt(s, 7), 10) for s in (1, 2)]
+        with pytest.raises(ServingKilled):
+            ce.drain(max_ticks=100)
+        assert not any(r.done for r in reqs)
+        engB = ServingEngine(model, params, cfg, journal=jp)
+        rec = engB.recover()
+        assert len(rec) == 2
+        # the killed tick's tokens are NOT in the journal prefix
+        assert all(len(r.tokens) < len(o.tokens)
+                   for r, o in zip(rec, reqs))
+        engB.drain(max_ticks=200)
+        for r in rec:
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens),
+                _ref_tokens(model, params, r.prompt, 10),
+                err_msg=f"post-kill recovery diverged for {r.id}",
+            )
+
+
+class TestTemperatureDeterminism:
+    def test_preemption_resume_deterministic_nongreedy(self, model,
+                                                       params):
+        """temperature > 0: a preempted-and-resumed request re-samples
+        the SAME tokens as an undisturbed run — the sampling key for
+        output position i of request r depends only on (r.seed, i),
+        never on scheduler state (the ServingEngine docstring's
+        guarantee)."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        kw = dict(block_tokens=8, temperature=1.0, top_k=16)
+        tight = ServingEngine(
+            model, params,
+            _serve_config(max_active=3, num_blocks=5, **kw))
+        roomy = ServingEngine(
+            model, params,
+            _serve_config(max_active=3, num_blocks=24, **kw))
+        outs = []
+        preemptions = []
+        for eng in (tight, roomy):
+            reqs = [eng.submit(_prompt(s, 10), 14, seed=100 + s)
+                    for s in (1, 2, 3)]
+            eng.drain(max_ticks=2000)
+            outs.append([list(r.tokens) for r in reqs])
+            preemptions.append(sum(r.preemptions for r in reqs))
+        assert preemptions[0] >= 1, (
+            "tight pool was sized to force at least one preemption"
+        )
+        assert preemptions[1] == 0
+        assert outs[0] == outs[1], (
+            "temperature>0 resume diverged from the undisturbed run"
+        )
+
+
+class TestRunTraceGuards:
+    def test_no_progress_bound_names_state(self, model, params):
+        """An engine that can never admit its queue must raise the
+        no-progress bound (naming queue/pool state), not spin to
+        max_ticks."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.serving.driver import Arrival, run_trace
+        eng = ServingEngine(model, params, _serve_config())
+        # simulate the post-incident pool shrink: every block vanishes
+        # after the admission check, so the queued prompt never admits
+        eng.pool._free = []
+        with pytest.raises(RuntimeError,
+                           match=r"no progress .* queue_depth=1"):
+            run_trace(eng, [Arrival(0.0, _prompt(1, 7), 4)],
+                      realtime=False, no_progress_ticks=10)
+
+
 class TestOffPathSafety:
     def test_training_hlo_identical_with_serving_imported(self):
         """The training step's HLO is byte-identical with the serving
         package imported AND a live ServingEngine constructed — in a
         fresh subprocess, so the import order is genuinely
         before/after (an in-process pin would be vacuous once any other
-        test imported serving)."""
+        test imported serving).  The robustness layer rides the same
+        pin: serving.guard and serving.journal are imported explicitly
+        and the engine is built with the health guard ON (its default),
+        so the ISSUE-8 acceptance 'training HLO byte-identical with
+        serving.guard imported' is exactly what this asserts."""
         script = r"""
 import json
 import jax
@@ -391,10 +779,12 @@ eng = SingleDevice(GPT2Model(cfg), SGD(lr=0.1))
 state = eng.init(jax.random.PRNGKey(0))
 before = eng._step.lower(state, batch).as_text()
 from tiny_deepspeed_tpu.serving import ServeConfig, ServingEngine
+from tiny_deepspeed_tpu.serving import guard as _guard   # noqa: F401
+from tiny_deepspeed_tpu.serving import journal as _jrn   # noqa: F401
 model = GPT2Model(cfg)
 se = ServingEngine(model, model.init(jax.random.PRNGKey(0)),
                    ServeConfig(max_active=2, num_blocks=4,
-                               block_tokens=8))
+                               block_tokens=8, health_guard=True))
 eng2 = SingleDevice(GPT2Model(cfg), SGD(lr=0.1))
 state2 = eng2.init(jax.random.PRNGKey(0))
 after = eng2._step.lower(state2, batch).as_text()
@@ -487,4 +877,91 @@ class TestServingSoak:
                 np.asarray(r.tokens),
                 _ref_tokens(model, params, r.prompt, 14),
                 err_msg=f"request {r.id} diverged after preemption",
+            )
+
+
+@pytest.mark.slow
+class TestServingFaultSoak:
+    """ISSUE-8 acceptance runs: real SIGKILL recovery, goodput under a
+    sustained fault schedule.  Slow tier from the start — each pays
+    fresh compiles in subprocesses or long drains."""
+
+    def test_kill_mid_trace_sigkill_recovery_token_exact(self,
+                                                         tmp_path):
+        """SIGKILL the serving process from the journal's commit hook
+        (a REAL death between journal-append and fsync), recover a
+        fresh engine in a new process, and pin that every interrupted
+        request's FINAL sequence equals the uninterrupted run's — the
+        headline crash-recovery acceptance."""
+        here = os.path.dirname(os.path.abspath(__file__))
+        jp = str(tmp_path / "journal.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+
+        def run(mode, check=True):
+            out = subprocess.run(
+                [sys.executable, os.path.join(here, "serving_worker.py"),
+                 mode, jp],
+                capture_output=True, text=True, timeout=600, env=env,
+            )
+            if check:
+                assert out.returncode == 0, out.stderr[-2000:]
+                return json.loads(out.stdout.strip().splitlines()[-1])
+            return out
+
+        straight = run("straight")["outputs"]
+        killed = run("serve", check=False)
+        assert killed.returncode == -9, (
+            f"worker was supposed to die by SIGKILL, got rc="
+            f"{killed.returncode}: {killed.stderr[-1000:]}"
+        )
+        assert os.path.exists(jp), "journal must survive the kill"
+        rec = run("recover")
+        assert rec["recovered"], "the kill left no in-flight requests?"
+        assert all(s == "ok" for s in rec["statuses"].values())
+        for rid, toks in rec["outputs"].items():
+            assert toks == straight[rid], (
+                f"request {rid} diverged across SIGKILL+recover:\n"
+                f"  recovered: {toks}\n  straight:  {straight[rid]}"
+            )
+
+    def test_chaos_goodput_counts_exact_and_neighbors_unharmed(
+            self, model, params):
+        """Slot-poison + tick-delay chaos over a 10-request closed-loop
+        trace: the poisoned requests fail, EVERY other request finishes
+        `ok` AND token-exact with `generate` (no whole-batch failure),
+        and the JSONL/summary status counts are exact for the
+        deterministic fault schedule."""
+        from tiny_deepspeed_tpu.resilience import (
+            Chaos, ChaosServingEngine,
+        )
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.serving.driver import (
+            poisson_trace, run_trace,
+        )
+        trace = poisson_trace(10, rate_rps=None, prompt_lens=[7, 13],
+                              max_new_tokens=12, vocab_size=128, seed=0)
+        eng = ServingEngine(model, params,
+                            _serve_config(max_active=4, num_blocks=24))
+        # two NON-consecutive poisons (no watchdog restart) + one delay
+        chaos = Chaos(seed=7, tick_nan_steps=(4, 8),
+                      tick_delay_steps=(6,), delay_s=0.05)
+        res = run_trace(ChaosServingEngine(eng, chaos), trace,
+                        realtime=False)
+        counts = res["status_counts"]
+        assert counts == {"ok": 8, "shed": 0, "expired": 0,
+                          "failed": 2}, counts
+        assert res["restarts"] == 0
+        n_nan = sum(1 for f in chaos.injected
+                    if f["fault"] == "tick_nan" and f.get("slot", -1)
+                    >= 0)
+        assert counts["failed"] == n_nan
+        assert 0 < res["ok_tokens_per_s"] <= res["tokens_per_s"]
+        ok = [r for r in res["requests"] if r.status == "ok"]
+        for r in ok:
+            np.testing.assert_array_equal(
+                np.asarray(r.tokens),
+                _ref_tokens(model, params, r.prompt, 12),
+                err_msg=f"unpoisoned request {r.id} diverged under "
+                        "chaos",
             )

@@ -35,3 +35,48 @@ def sample_logits(logit, key, temperature: float,
     return jax.random.categorical(
         key, logit / temperature
     ).astype(jnp.int32)
+
+
+def request_position_key(base_key, seed, position):
+    """The serving tier's deterministic sampling key: fold (per-request
+    seed, token position) into the engine's base key.  Because the key
+    depends ONLY on which request and which output position — never on
+    the scheduler tick, batch composition, or how many times the request
+    was preempted/restarted — a temperature > 0 request resumed from
+    prompt + produced prefix re-samples the SAME continuation the
+    uninterrupted run would have (categorical is Gumbel argmax, so it
+    shares greedy argmax's robustness to the prefill-vs-decode numeric
+    path difference).  `seed`/`position` may be traced scalars."""
+    return jax.random.fold_in(jax.random.fold_in(base_key, seed), position)
+
+
+def sample_logits_at(logit, base_key, seed, position, temperature: float,
+                     top_k: Optional[int] = None):
+    """(B, V) logits sampled under the (seed, position) request key —
+    the ONE dispatch both serving surfaces ride (prefill directly,
+    decode row-wise through `sample_logits_per_slot`), so the greedy
+    short-circuit and the key derivation can never drift between the
+    two paths the determinism guarantee compares."""
+    if temperature == 0.0:
+        return sample_logits(logit, None, 0.0, top_k)
+    key = request_position_key(base_key, seed, position)
+    return sample_logits(logit, key, temperature, top_k)
+
+
+def sample_logits_per_slot(logit, base_key, seeds, positions,
+                           temperature: float,
+                           top_k: Optional[int] = None):
+    """Per-slot sampling for the serving decode step: row i of the
+    (S, V) logits samples under request_position_key(base_key, seeds[i],
+    positions[i]).  Delegates row-wise to `sample_logits_at` via vmap;
+    temperature == 0.0 short-circuits to the identical greedy argmax
+    (keys never materialize — the compiled greedy program is
+    unchanged)."""
+    if temperature == 0.0:
+        return sample_logits(logit, None, 0.0, top_k)
+
+    def one(row, seed, pos):
+        return sample_logits_at(row[None], base_key, seed, pos,
+                                temperature, top_k)[0]
+
+    return jax.vmap(one)(logit, seeds, positions)

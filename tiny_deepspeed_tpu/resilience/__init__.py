@@ -37,7 +37,8 @@ from .checkpoint import CheckpointManager, PreemptionGuard
 from .elastic import (
     check_reshapeable, data_offset_batches, elastic_load,
 )
-from .chaos import Chaos, ChaosEngine
+from .chaos import (Chaos, ChaosEngine, ChaosServingEngine,
+                    parse_serving_chaos)
 from .straggler import ShardRebalancer, rebalance_shares
 
 __all__ = [
@@ -48,6 +49,8 @@ __all__ = [
     "data_offset_batches",
     "Chaos",
     "ChaosEngine",
+    "ChaosServingEngine",
+    "parse_serving_chaos",
     "ShardRebalancer",
     "rebalance_shares",
 ]

@@ -16,7 +16,7 @@ a failing chaos test replays bit-identically from its seed.
     ...
     chaos.uninstall()
 
-Fault kinds:
+Fault kinds (training, via ChaosEngine):
   * "nan"    — poison one parameter with NaN AFTER the injected step:
                the next forward/backward produces non-finite loss and
                gradients everywhere (exactly how real overflow spreads),
@@ -29,6 +29,24 @@ Fault kinds:
                attempts (exercises retry/backoff) and `kill_next_commit`
                a CheckpointKilled between tmp-write and commit
                (exercises the uncommitted-dir skip on restore).
+
+Fault kinds (serving, via ChaosServingEngine — tick-scoped, the tick
+counter counts `tick()` calls on the wrapper):
+  * "tick_nan"     — NaN-poison ONE active slot's decode logits this
+                     tick (deterministic slot rotation over the active
+                     set); drives the decode-health guard's quarantine
+                     and, when consecutive, its warm-restart watchdog.
+  * "tick_delay"   — sleep `delay_s` before the tick (a stalled device
+                     or host; inflates TTFT/deadline pressure — what
+                     the SLO shedding reacts to).
+  * "prefill_raise"— raise inside the next admission's prefill
+                     (exercises the tick-exception warm restart with
+                     the half-admitted request re-queued).
+  * "journal_kill" — ServingKilled between the request journal's
+                     line-append and its per-tick fsync commit: the
+                     buffered tick is lost exactly as a SIGKILL at the
+                     worst moment would lose it (exercises
+                     ServingEngine.recover's re-decode of the tail).
 """
 
 from __future__ import annotations
@@ -43,7 +61,9 @@ import jax.numpy as jnp
 
 from ..utils.checkpoint import CheckpointKilled, set_io_hook
 
-_KIND_CODE = {"nan": 1, "delay": 2, "sigterm": 3}
+_KIND_CODE = {"nan": 1, "delay": 2, "sigterm": 3,
+              "tick_nan": 4, "tick_delay": 5, "prefill_raise": 6,
+              "journal_kill": 7}
 
 
 class Chaos:
@@ -56,7 +76,13 @@ class Chaos:
                  delay_prob: float = 0.0,
                  delay_s: float = 0.25,
                  sigterm_step: Optional[int] = None,
-                 ckpt_write_failures: int = 0):
+                 ckpt_write_failures: int = 0,
+                 tick_nan_steps: Iterable[int] = (),
+                 tick_nan_prob: float = 0.0,
+                 tick_delay_steps: Iterable[int] = (),
+                 tick_delay_prob: float = 0.0,
+                 prefill_raise_steps: Iterable[int] = (),
+                 journal_kill_step: Optional[int] = None):
         self.seed = int(seed)
         self.delay_s = float(delay_s)
         self._steps = {
@@ -65,9 +91,20 @@ class Chaos:
             "sigterm": frozenset(
                 () if sigterm_step is None else (int(sigterm_step),)
             ),
+            "tick_nan": frozenset(int(s) for s in tick_nan_steps),
+            "tick_delay": frozenset(int(s) for s in tick_delay_steps),
+            "prefill_raise": frozenset(
+                int(s) for s in prefill_raise_steps),
+            "journal_kill": frozenset(
+                () if journal_kill_step is None
+                else (int(journal_kill_step),)
+            ),
         }
         self._prob = {"nan": float(nan_prob), "delay": float(delay_prob),
-                      "sigterm": 0.0}
+                      "sigterm": 0.0,
+                      "tick_nan": float(tick_nan_prob),
+                      "tick_delay": float(tick_delay_prob),
+                      "prefill_raise": 0.0, "journal_kill": 0.0}
         self._write_fails_left = int(ckpt_write_failures)
         self._kill_commit = False
         self.injected: List[Dict] = []  # JSON-safe fault log
@@ -185,3 +222,120 @@ class ChaosEngine:
         if self.chaos.fires("nan", it):
             state = poison_params(state)
         return state, loss
+
+
+class ChaosServingEngine:
+    """Serving-engine proxy injecting tick-scoped faults (module
+    docstring, "serving" kinds).  Tracks its own tick counter (0-based,
+    counting `tick()` calls on the wrapper); everything else delegates
+    to the wrapped `serving.ServingEngine` — which is why `drain` is
+    re-implemented here: the engine's own drain would call the engine's
+    tick and sail straight past the faults."""
+
+    def __init__(self, engine, chaos: Chaos):
+        self.engine = engine
+        self.chaos = chaos
+        self.ticks_run = 0
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+    def tick(self) -> int:
+        t = self.ticks_run
+        self.ticks_run += 1
+        if self.chaos.fires("tick_delay", t):
+            time.sleep(self.chaos.delay_s)
+        if self.chaos.fires("tick_nan", t):
+            active = self.engine.active_slots()
+            if active:
+                slot = active[t % len(active)]
+                self.engine.poison_slot(slot)
+                self.chaos.injected[-1]["slot"] = slot
+            else:
+                # recorded by fires() but nothing to poison this tick
+                self.chaos.injected[-1]["slot"] = -1
+        if self.chaos.fires("prefill_raise", t):
+            self.engine.arm_prefill_exception(
+                RuntimeError(f"chaos: injected prefill failure at "
+                             f"tick {t}")
+            )
+        if self.chaos.fires("journal_kill", t):
+            if self.engine.journal is None:
+                raise ValueError(
+                    "chaos journal_kill armed but the engine has no "
+                    "journal — construct ServingEngine(journal=...)"
+                )
+            from ..serving.journal import ServingKilled
+
+            def _kill():
+                raise ServingKilled(
+                    "chaos: killed between journal append and commit"
+                )
+
+            self.engine.journal.arm_commit_hook(_kill)
+        return self.engine.tick()
+
+    def drain(self, max_ticks: Optional[int] = None) -> int:
+        total = 0
+        ticks = 0
+        while self.engine.queue_depth or self.engine.n_active:
+            total += self.tick()
+            ticks += 1
+            if max_ticks is not None and ticks > max_ticks:
+                raise RuntimeError(
+                    f"drain exceeded {max_ticks} ticks with "
+                    f"{self.engine.queue_depth} queued"
+                )
+        return total
+
+
+def parse_serving_chaos(spec: str, *, seed: int = 0,
+                        delay_s: float = 0.25) -> Chaos:
+    """Build a serving Chaos schedule from a CLI spec string
+    (scripts/serve_bench.py --chaos).  Comma-separated entries:
+
+        kind@tick     fire `kind` at that tick       nan@5,delay@7
+        kind%prob     seeded per-tick probability    nan%0.02
+        journal_kill@tick                            journal_kill@9
+
+    Kinds: nan (slot-poison), delay (tick delay), prefill (prefill
+    raise), journal_kill.  The schedule is deterministic from
+    (spec, seed) — the same A/B replays bit-identically."""
+    kinds = {"nan": "tick_nan", "delay": "tick_delay",
+             "prefill": "prefill_raise", "journal_kill": "journal_kill"}
+    steps: Dict[str, List[int]] = {k: [] for k in kinds.values()}
+    probs: Dict[str, float] = {}
+    journal_kill = None
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        for sep in ("@", "%"):
+            if sep in entry:
+                kind, val = entry.split(sep, 1)
+                break
+        else:
+            raise ValueError(
+                f"chaos entry {entry!r}: expected kind@tick or kind%prob"
+            )
+        if kind not in kinds:
+            raise ValueError(
+                f"unknown chaos kind {kind!r} (one of {sorted(kinds)})"
+            )
+        if sep == "%":
+            if kinds[kind] in ("prefill_raise", "journal_kill"):
+                raise ValueError(f"{kind} only supports kind@tick")
+            probs[kinds[kind]] = float(val)
+        elif kinds[kind] == "journal_kill":
+            journal_kill = int(val)
+        else:
+            steps[kinds[kind]].append(int(val))
+    return Chaos(
+        seed=seed, delay_s=delay_s,
+        tick_nan_steps=steps["tick_nan"],
+        tick_nan_prob=probs.get("tick_nan", 0.0),
+        tick_delay_steps=steps["tick_delay"],
+        tick_delay_prob=probs.get("tick_delay", 0.0),
+        prefill_raise_steps=steps["prefill_raise"],
+        journal_kill_step=journal_kill,
+    )

@@ -8,17 +8,25 @@ ServingEngine) changes NOTHING about training — the training step's HLO
 is byte-identical with serving imported but unused, pinned in
 tests/test_serving.py alongside the telemetry=off convention.
 
-  * `pool`   — paged KV block pool + block tables, int8/fp8 cache blocks
-  * `engine` — ServingEngine: prefill/decode phase split, admission,
-               eviction, preemption, telemetry
-  * `driver` — synthetic Poisson-arrivals load driver + the serial
-               `generate()` baseline (bench + tests share it)
+  * `pool`    — paged KV block pool + block tables, int8/fp8 cache blocks
+  * `engine`  — ServingEngine: prefill/decode phase split, admission,
+                eviction, preemption, SLO shedding/expiry, warm
+                restart, telemetry
+  * `guard`   — decode-health guard: per-slot non-finite quarantine +
+                the warm-restart watchdog
+  * `journal` — crash-recoverable request journal (write-ahead log
+                behind ServingEngine.recover)
+  * `driver`  — synthetic Poisson-arrivals load driver + the serial
+                `generate()` baseline (bench + tests share it)
 """
 
 from .engine import Request, ServeConfig, ServingEngine
+from .guard import DecodeHealthGuard
+from .journal import RequestJournal, ServingKilled
 from .pool import KVPoolView, PagedKVPool, PageRef
 
 __all__ = [
     "Request", "ServeConfig", "ServingEngine",
+    "DecodeHealthGuard", "RequestJournal", "ServingKilled",
     "KVPoolView", "PagedKVPool", "PageRef",
 ]

@@ -17,19 +17,24 @@ import numpy as np
 
 class Arrival(NamedTuple):
     """One trace entry: when (seconds from trace start; 0.0 everywhere
-    = closed-loop max-pressure mode), what prompt, how many tokens."""
+    = closed-loop max-pressure mode), what prompt, how many tokens —
+    plus an optional per-request completion deadline (seconds from
+    submission; the engine's SLO machinery sheds/expires around it)."""
 
     at_s: float
     prompt: List[int]
     max_new_tokens: int
+    deadline_s: Optional[float] = None
 
 
 def poisson_trace(n_requests: int, *, rate_rps: Optional[float],
                   prompt_lens: Sequence[int], max_new_tokens: int,
-                  vocab_size: int, seed: int = 0) -> List[Arrival]:
+                  vocab_size: int, seed: int = 0,
+                  deadline_s: Optional[float] = None) -> List[Arrival]:
     """Exponential inter-arrivals at `rate_rps` (None = all at t=0),
     prompts drawn uniformly from `prompt_lens` / the vocab.  Seeded —
-    the same trace replays against every engine configuration."""
+    the same trace replays against every engine configuration.
+    `deadline_s` stamps every arrival with the same completion SLO."""
     rng = np.random.default_rng(seed)
     t = 0.0
     trace = []
@@ -38,7 +43,7 @@ def poisson_trace(n_requests: int, *, rate_rps: Optional[float],
             t += float(rng.exponential(1.0 / rate_rps))
         plen = int(rng.choice(np.asarray(prompt_lens)))
         prompt = rng.integers(0, vocab_size, size=plen).tolist()
-        trace.append(Arrival(t, prompt, max_new_tokens))
+        trace.append(Arrival(t, prompt, max_new_tokens, deadline_s))
     return trace
 
 
@@ -54,26 +59,38 @@ def _latency_stats(lats: List[float]) -> dict:
 
 
 def run_trace(engine, trace: Sequence[Arrival], *,
-              realtime: bool = True, max_ticks: int = 200_000) -> dict:
-    """Drive `engine` (serving.ServingEngine) through the trace.
+              realtime: bool = True, max_ticks: int = 200_000,
+              no_progress_ticks: int = 2_000) -> dict:
+    """Drive `engine` (serving.ServingEngine or a ChaosServingEngine
+    wrapper) through the trace.
 
     realtime=True honors arrival times with wall-clock waits (what the
     latency percentiles mean under open-loop load); realtime=False
     submits each arrival as soon as the engine drains ahead of it
     (closed-loop — tests use it to avoid sleeping).  Returns outputs
     per request plus aggregate metrics; per-token latency covers every
-    produced token (first token = TTFT)."""
+    produced token (first token = TTFT).  `status_counts` and
+    `ok_tokens_per_s` (goodput: tokens of requests that finished "ok")
+    summarize the terminal outcomes under faults/SLOs.
+
+    `no_progress_ticks` bounds LIVELOCK, which `max_ticks` alone cannot:
+    an engine that can never admit its queue (e.g. every prompt refused
+    after the pool shrank) ticks forever producing nothing.  After that
+    many CONSECUTIVE zero-token ticks with work still pending, raise
+    with the queue/pool state named instead of spinning to max_ticks."""
     requests = []
     pending = list(trace)
     occupancy = []
     pool_util = []
     t0 = time.monotonic()
     ticks = 0
+    idle_ticks = 0
     while pending or engine.queue_depth or engine.n_active:
         now = time.monotonic() - t0
         while pending and (not realtime or pending[0].at_s <= now):
             a = pending.pop(0)
-            requests.append(engine.submit(a.prompt, a.max_new_tokens))
+            requests.append(engine.submit(
+                a.prompt, a.max_new_tokens, deadline_s=a.deadline_s))
             if not realtime:
                 break  # one per spin: admission interleaves with decode
         if (realtime and not engine.queue_depth and not engine.n_active
@@ -84,22 +101,42 @@ def run_trace(engine, trace: Sequence[Arrival], *,
                 time.monotonic() - t0)))
             continue
         if engine.queue_depth or engine.n_active:
-            engine.tick()
+            produced = engine.tick()
             occupancy.append(engine.n_active / engine.config.max_active)
             pool_util.append(
                 engine.pool.blocks_in_use / engine.pool.num_usable)
+            idle_ticks = 0 if produced else idle_ticks + 1
+            if idle_ticks >= no_progress_ticks:
+                raise RuntimeError(
+                    f"engine made no progress for {idle_ticks} "
+                    f"consecutive ticks: queue_depth="
+                    f"{engine.queue_depth}, active={engine.n_active}, "
+                    f"pool blocks_free={engine.pool.blocks_free}/"
+                    f"{engine.pool.num_usable} — every queued request "
+                    "is unadmittable (pool too small for its prompt, "
+                    "or blocks leaked)"
+                )
         ticks += 1
         if ticks > max_ticks:
             raise RuntimeError(f"trace did not drain in {max_ticks} ticks")
     wall = time.monotonic() - t0
     toks = sum(len(r.tokens) for r in requests)
     lats = [lat for r in requests for lat in r.token_lat]
+    status_counts = {"ok": 0, "shed": 0, "expired": 0, "failed": 0}
+    for r in requests:
+        status_counts[r.status] = status_counts.get(r.status, 0) + 1
+    ok_toks = sum(len(r.tokens) for r in requests if r.status == "ok")
     return {
         "outputs": {r.id: list(r.tokens) for r in requests},
         "requests": requests,
         "tokens": toks,
         "wall_s": round(wall, 4),
         "tokens_per_s": round(toks / max(wall, 1e-9), 2),
+        # goodput: only tokens delivered to requests that finished OK
+        # count — shed/expired/failed work is wasted capacity
+        "ok_tokens_per_s": round(ok_toks / max(wall, 1e-9), 2),
+        "status_counts": status_counts,
+        "restarts": engine.restarts,
         "token_latency": _latency_stats(lats),
         "ttft": _latency_stats(
             [r.t_first - r.t_arrival for r in requests

@@ -26,15 +26,52 @@ Phase split, two compiled programs:
 
 Block exhaustion preempts the YOUNGEST active request (its blocks free
 immediately; it re-queues at the FRONT and later re-prefills from
-prompt + tokens-produced-so-far, which under greedy decoding continues
-the exact sequence).  A request that could never fit the pool at all is
-refused at submit().
+prompt + tokens-produced-so-far, which continues the exact sequence).
+A request that could never fit the pool at all is refused at submit().
+
+Fault posture (the serving robustness layer):
+
+  * SLOs — `submit(..., deadline_s=)` attaches a completion deadline
+    (seconds from arrival).  The scheduler SHEDS queued requests whose
+    deadline is overdue or unmeetable (priced from the measured
+    per-tick decode-wall history), EXPIRES active requests that blow
+    their deadline, and REFUSES admission outright above the
+    `max_queue` / `shed_pool_util` watermarks — so a deadline-blind
+    queue can never grow unboundedly.  Every outcome is a distinct
+    terminal status on the request and its JSONL record:
+    `ok` / `shed` / `expired` / `failed`.
+  * Decode health — the compiled decode step reduces each slot's
+    logits to a per-slot non-finite flag fetched alongside the sampled
+    tokens (no extra device sync); poisoned slots are QUARANTINED
+    (blocks freed, request `failed`, the rest of the batch keeps
+    serving), and a watchdog WARM-RESTARTS the engine — fresh pool +
+    slot array, compiled programs kept — after `guard_k_restart`
+    consecutive poisoned ticks or any exception out of a tick
+    (serving/guard.py).
+  * Crash recovery — an append-only request journal (admissions +
+    produced tokens, fsync batched per tick; serving/journal.py) lets
+    `recover()` re-queue a dead engine's in-flight requests
+    front-of-line with their produced prefix, riding the preemption
+    resume path.
+
+Determinism guarantee: sampling keys derive ONLY from (request seed,
+output position) — `models/sampling.request_position_key` — never from
+the scheduler tick, batch composition, preemption count, or restarts.
+Greedy (temperature == 0) continuation is token-exact by argmax;
+temperature > 0 re-samples the SAME tokens after preemption, warm
+restart, or journal recovery because position i of request r always
+draws from the same key (categorical is Gumbel argmax, sharing greedy's
+robustness to the prefill-vs-decode numeric path difference).  A
+request's token sequence is therefore a pure function of
+(params, prompt, seed) — which is exactly what makes the journal's
+"re-queue with produced prefix" recovery exact.
 
 Telemetry: batch-occupancy / pool-utilization / queue-depth /
-eviction-rate gauges (registered in telemetry/schema.GAUGES), admission/
-eviction/preemption/token counters, TTFT + inter-token latency
-histograms, and a per-request `request` record into the JSONL metrics
-stream on finish.
+eviction-rate gauges plus the fault-path serve_shed / serve_expired /
+serve_quarantined / serve_restarts gauges (telemetry/schema.GAUGES),
+admission/eviction/preemption/token counters, TTFT + inter-token latency
+histograms, and a per-request `request` record (terminal `status` field)
+into the JSONL metrics stream at every terminal outcome.
 """
 
 from __future__ import annotations
@@ -43,15 +80,21 @@ import dataclasses
 import itertools
 import time
 from collections import deque
-from typing import Deque, List, Optional, Sequence
+from typing import Deque, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..models.gpt2 import resolved_cache_dtype
-from ..models.sampling import sample_logits
+from ..models.sampling import sample_logits_at, sample_logits_per_slot
+from .guard import DecodeHealthGuard
+from .journal import RequestJournal, ServingKilled
 from .pool import SCRATCH_BLOCK, PagedKVPool, page_ref
+
+# decode-wall samples needed before deadline shedding trusts its price
+# estimate (a cold engine must not shed on compile-time noise)
+_MIN_GAP_SAMPLES = 5
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,21 +124,44 @@ class ServeConfig:
     # serving <=40-token requests would otherwise pay a 256-position
     # panel (6x the attention read) every token
     max_seq_tokens: Optional[int] = None
+    # admission watermarks: submit() SHEDS (terminal status "shed",
+    # never queued) when the queue already holds max_queue requests, or
+    # when the pool is at shed_pool_util utilization with a backlog —
+    # load shedding at the door instead of unbounded queue growth
+    max_queue: Optional[int] = None
+    shed_pool_util: Optional[float] = None
+    # decode-health guard (serving/guard.py): per-tick non-finite logit
+    # check + quarantine + warm-restart watchdog.  guard_k_restart =
+    # consecutive poisoned ticks before the watchdog trips.
+    health_guard: bool = True
+    guard_k_restart: int = 3
 
 
 class Request:
     """One generation request through its lifecycle:
     queued -> active -> done (possibly bouncing back to queued on
-    preemption).  Wall-clock latency marks use time.monotonic()."""
+    preemption, warm restart, or journal recovery).  `status` is the
+    terminal outcome: "ok" (finished), "shed" (never served — refused
+    at the watermark or deadline-unmeetable in queue), "expired"
+    (served but blew its deadline), "failed" (quarantined on
+    non-finite decode logits).  Wall-clock marks use time.monotonic()."""
 
     _ids = itertools.count()
 
-    def __init__(self, prompt: Sequence[int], max_new_tokens: int):
-        self.id = next(Request._ids)
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int, *,
+                 deadline_s: Optional[float] = None,
+                 seed: Optional[int] = None, id: Optional[int] = None):
+        self.id = next(Request._ids) if id is None else int(id)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        # per-request sampling seed: with temperature > 0, token i draws
+        # from fold(fold(engine_base_key, seed), i) — deterministic
+        # across preemption/restart/recovery (module docstring)
+        self.seed = self.id if seed is None else int(seed)
         self.tokens: List[int] = []  # generated (includes eos when hit)
         self.state = "queued"
+        self.status: Optional[str] = None  # terminal: ok/shed/expired/failed
         self.finish_reason: Optional[str] = None
         self.preemptions = 0
         now = time.monotonic()
@@ -105,10 +171,20 @@ class Request:
         self.t_done: Optional[float] = None
         self.active_s = 0.0  # completed active windows (preemptions)
         self.token_lat: List[float] = []  # per-token completion gaps
+        self._journaled = False
 
     @property
     def done(self) -> bool:
         return self.state == "done"
+
+    @property
+    def deadline(self) -> Optional[float]:
+        """Absolute monotonic deadline (None = no SLO).  Recovered
+        requests re-base on their recovery time — the original arrival
+        clock died with the old process."""
+        if self.deadline_s is None:
+            return None
+        return self.t_arrival + self.deadline_s
 
 
 class _Slot:
@@ -125,10 +201,16 @@ class _Slot:
 
 
 class ServingEngine:
-    """Continuous-batching inference engine over one model + params."""
+    """Continuous-batching inference engine over one model + params.
+
+    See the module docstring for the scheduling and fault-handling
+    contract; the determinism guarantee (sampling keys from (request
+    seed, position) only) is what makes preemption resume, warm restart,
+    and `recover()` all token-exact — at temperature 0 AND above."""
 
     def __init__(self, model, params, config: ServeConfig = ServeConfig(),
-                 *, telemetry=None, logger=None):
+                 *, telemetry=None, logger=None,
+                 journal: Union[None, str, RequestJournal] = None):
         if not getattr(model, "paged_decode_capable", False):
             raise ValueError(
                 f"{type(model).__name__} does not support the paged "
@@ -149,6 +231,8 @@ class ServingEngine:
         self.config = config
         self.telemetry = telemetry
         self.logger = logger
+        self.journal = (RequestJournal(journal)
+                        if isinstance(journal, str) else journal)
         self.max_seq = config.max_seq_tokens or c.block_size
         if not 1 <= self.max_seq <= c.block_size:
             raise ValueError(
@@ -156,40 +240,63 @@ class ServingEngine:
                 f"[1, block_size={c.block_size}]"
             )
         kv_heads = getattr(c, "kv_heads", c.n_head)
-        self.pool = PagedKVPool(
+        self._pool_args = dict(
             n_layer=c.n_layer, kv_heads=kv_heads, head_dim=c.head_dim,
             num_blocks=config.num_blocks,
             block_tokens=config.block_tokens,
             dtype=resolved_cache_dtype(c), quant=config.quant,
         )
+        self.pool = PagedKVPool(**self._pool_args)
         # one block table row per slot, wide enough for a max_seq
         # request; unused entries point at scratch
         self.max_blocks_per_req = -(-self.max_seq // config.block_tokens)
         self._slots: List[Optional[_Slot]] = [None] * config.max_active
         self._queue: Deque[Request] = deque()
-        self._key = jax.random.PRNGKey(config.seed)
+        self._guard = (DecodeHealthGuard(config.guard_k_restart)
+                       if config.health_guard else None)
         self._ticks = 0
         self._evictions = 0
+        self._shed = 0
+        self._expired = 0
+        self._quarantined = 0
+        self._restarts = 0
+        self._restarts_since_progress = 0
+        # recent decode-step walls: the measured inter-token service
+        # time that prices deadline feasibility for queue shedding
+        self._gap_hist: Deque[float] = deque(maxlen=128)
+        # chaos / fault-injection hooks (resilience/chaos.py)
+        self._poison_pending: set = set()
+        self._prefill_exc: Optional[BaseException] = None
         self.last_logits = None  # (S, V) f32 of the last decode tick
 
         bt = config.block_tokens
         temp, top_k = config.temperature, config.top_k
+        base_key = jax.random.PRNGKey(config.seed)
 
-        def decode_step(params, stacked, view, tokens, pos, tables, key):
+        def decode_step(params, stacked, view, tokens, pos, tables,
+                        seeds, nprod, poison):
             x = model._embed_decode(params, tokens, pos)
             page = page_ref(tables, pos, bt)
             x, view = model.paged_decode(stacked, x, view, page)
             logits = model.head(params, x)[:, 0]
-            nxt = sample_logits(logits, key, temp, top_k)
-            return nxt, logits, view
+            # chaos operand: 0.0 off-path (tokens bit-identical — x+0.0
+            # never changes an argmax or a categorical draw), NaN on a
+            # poisoned slot.  The per-slot health flag rides the same
+            # computation the token fetch already synchronizes on.
+            logits = logits + poison[:, None]
+            bad = ~jnp.all(jnp.isfinite(logits), axis=-1)
+            nxt = sample_logits_per_slot(
+                logits, base_key, seeds, nprod, temp, top_k)
+            return nxt, logits, bad, view
 
         def prefill_step(params, stacked, prompt, last_pos, block_ids,
-                         view, key):
+                         view, seed, nprod):
             logits, view = model.paged_prefill(
                 params, prompt, last_pos, block_ids, view, bt,
                 stacked=stacked,
             )
-            nxt = sample_logits(logits, key, temp, top_k)
+            nxt = sample_logits_at(logits, base_key, seed, nprod, temp,
+                                   top_k)
             return nxt, view
 
         # the pool view is DONATED through both programs: each step
@@ -201,10 +308,17 @@ class ServingEngine:
 
     # -- public API ---------------------------------------------------------
 
-    def submit(self, prompt: Sequence[int],
-               max_new_tokens: int) -> Request:
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               deadline_s: Optional[float] = None,
+               seed: Optional[int] = None) -> Request:
         """Queue one request; returns its handle (tokens accumulate on
-        it as ticks produce them)."""
+        it as ticks produce them).  `deadline_s` attaches a completion
+        SLO (seconds from now); `seed` pins the temperature>0 sampling
+        stream (default: the request id).  Above the admission
+        watermarks the request comes back already terminal with
+        status "shed" — check `req.status`, not an exception: overload
+        is an expected outcome, a malformed request is not (those still
+        raise ValueError)."""
         c = self.model.config
         if len(prompt) < 1 or max_new_tokens < 1:
             raise ValueError("need a non-empty prompt and >= 1 new token")
@@ -223,51 +337,54 @@ class ServingEngine:
                 f"{self.pool.num_usable} — raise num_blocks or shrink "
                 "the request"
             )
-        req = Request(prompt, max_new_tokens)
-        self._queue.append(req)
+        req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
+                      seed=seed)
         self._count("serve_submitted")
+        cfg = self.config
+        if cfg.max_queue is not None and len(self._queue) >= cfg.max_queue:
+            self._shed_req(req, "queue_watermark")
+            return req
+        if (cfg.shed_pool_util is not None and self._queue
+                and self.pool.blocks_in_use / self.pool.num_usable
+                >= cfg.shed_pool_util):
+            self._shed_req(req, "pool_watermark")
+            return req
+        if self.journal is not None:
+            # admissions are durable at submit time (one fsync per
+            # submit; token lines batch per tick) — a crash right after
+            # submit() still replays the request
+            self.journal.submit(req)
+            req._journaled = True
+            self.journal.commit()
+        self._queue.append(req)
         return req
 
     def tick(self) -> int:
-        """One scheduler step: admit -> grow/preempt -> one decode step
-        for every active slot -> evict finished.  Returns the number of
-        tokens produced (prefill first-tokens included)."""
-        # growth first: existing slots claim the blocks their next write
-        # needs BEFORE admission can take them — the other order lets a
-        # fresh admission strand a grower, whose preempt-youngest victim
-        # is then the just-prefilled request (a full prefill thrown away
-        # per block boundary while the pool is tight)
-        self._grow()
-        produced = self._admit()
-        active = [(i, s) for i, s in enumerate(self._slots)
-                  if s is not None]
-        if active:
-            S = self.config.max_active
-            tokens = np.zeros((S,), np.int32)
-            pos = np.zeros((S,), np.int32)
-            tables = np.full((S, self.max_blocks_per_req), SCRATCH_BLOCK,
-                             np.int32)
-            for i, s in active:
-                tokens[i] = s.last
-                pos[i] = s.pos
-                tables[i, :len(s.table)] = s.table
-            nxt, logits, view = self._decode_fn(
-                self.params, self._stacked, self.pool.view,
-                tokens, pos, tables, self._next_key(),
-            )
-            self.pool.view = view
-            self.last_logits = logits
-            nxt = np.asarray(nxt)
-            tnow = time.monotonic()
-            for i, s in active:
-                t = int(nxt[i])
-                s.pos += 1
-                s.last = t
-                self._append_token(s.req, t, tnow)
-                produced += 1
-                if self._finished(s.req):
-                    self._finish(i, s)
+        """One scheduler step: enforce deadlines -> admit ->
+        grow/preempt -> one decode step for every active slot ->
+        quarantine/evict.  Returns the number of tokens produced
+        (prefill first-tokens included).
+
+        Any exception out of the tick body (a poisoned pool view, a
+        chaos-injected prefill failure) trips the watchdog warm restart
+        when the health guard is on: in-flight requests re-queue
+        front-of-line and continue token-exact.  `ServingKilled` (the
+        chaos stand-in for process death) always propagates — a real
+        kill leaves no engine to restart."""
+        try:
+            produced = self._tick_body()
+        except ServingKilled:
+            raise
+        except Exception as e:
+            if self._guard is None:
+                raise
+            self._warm_restart(f"tick exception: {type(e).__name__}: {e}")
+            produced = 0
+        if self.journal is not None:
+            self.journal.commit()
         self._ticks += 1
+        if produced:
+            self._restarts_since_progress = 0
         self._update_gauges()
         return produced
 
@@ -286,6 +403,54 @@ class ServingEngine:
                 )
         return total
 
+    def recover(self, journal: Union[None, str] = None) -> List[Request]:
+        """Re-queue a crashed engine's in-flight requests from its
+        journal, FRONT of the queue in their original admission order,
+        each with the token prefix the journal had committed — they
+        continue through the preemption resume path (re-prefill
+        prompt + produced), token-exact under the (seed, position)
+        sampling keys.  Requests the journal shows ALREADY finished —
+        every token produced, or an eos in the prefix — but whose end
+        line was torn away are closed out "ok" directly (re-queuing an
+        eos-finished request would decode PAST its eos and diverge
+        from the uninterrupted run).  Returns the
+        re-queued handles.  Call on a FRESH engine built with the same
+        model/params/config as the dead one (exactness needs the same
+        programs); latency marks restart at recovery time."""
+        path = journal
+        if path is None:
+            if self.journal is None:
+                raise ValueError(
+                    "recover() needs a journal path (or an engine "
+                    "constructed with journal=)"
+                )
+            path = self.journal.path
+        interrupted, done_ids = RequestJournal.replay(path)
+        out: List[Request] = []
+        max_seen = max(
+            [e["id"] for e in interrupted] + done_ids, default=-1)
+        for e in interrupted:
+            req = Request(e["prompt"], e["max_new"],
+                          deadline_s=e["deadline_s"], seed=e["seed"],
+                          id=e["id"])
+            req.tokens = list(e["tokens"])
+            req._journaled = self.journal is not None
+            if self._finished(req):
+                # finished before the crash (length OR eos) — only its
+                # end line was lost; close it out, never re-queue
+                self._terminal(req, "ok", req.finish_reason)
+            else:
+                out.append(req)
+        for req in reversed(out):
+            self._queue.appendleft(req)
+        # keep fresh ids clear of everything the journal ever issued
+        nxt = next(Request._ids)
+        Request._ids = itertools.count(max(nxt, max_seen + 1))
+        self._count("serve_recovered", len(out))
+        if self.journal is not None:
+            self.journal.commit()  # the closed-out requests' end lines
+        return out
+
     @property
     def n_active(self) -> int:
         return sum(s is not None for s in self._slots)
@@ -294,6 +459,14 @@ class ServingEngine:
     def queue_depth(self) -> int:
         return len(self._queue)
 
+    @property
+    def restarts(self) -> int:
+        return self._restarts
+
+    def active_slots(self) -> List[int]:
+        """Indices of occupied decode slots (chaos targets these)."""
+        return [i for i, s in enumerate(self._slots) if s is not None]
+
     def active_block_tables(self) -> dict:
         """{request id: list of physical block ids} for every active
         slot — what the pool-accounting acceptance sums against
@@ -301,19 +474,142 @@ class ServingEngine:
         return {s.req.id: list(s.table)
                 for s in self._slots if s is not None}
 
+    def poison_slot(self, i: int) -> None:
+        """Arm a NaN poison on slot i's logits for the NEXT decode step
+        (the chaos harness's slot-poison fault — resilience/chaos.py).
+        The poison rides a per-slot operand that is 0.0 off-path, so an
+        unpoisoned tick's tokens are bit-identical.  The fault model is
+        SLOT-addressed (a bad device lane), not request-addressed: it
+        hits whichever request occupies slot i at that decode step —
+        which can differ from the occupant at arm time if the scheduler
+        reseats the slot earlier in the same tick.  A tick that runs no
+        decode step discards the arm rather than letting it linger."""
+        if not 0 <= i < self.config.max_active:
+            raise ValueError(f"slot {i} out of range")
+        self._poison_pending.add(i)
+
+    def arm_prefill_exception(self, exc: BaseException) -> None:
+        """Arm ONE exception raised at the next admission's prefill
+        (chaos "prefill_raise"): the request re-queues, the watchdog
+        warm-restarts."""
+        self._prefill_exc = exc
+
     def describe(self) -> str:
         q = self.config.quant or str(jnp.dtype(self.pool.view.k.dtype))
         return (
             f"serving(max_active={self.config.max_active}, "
             f"blocks={self.pool.num_usable}x"
-            f"{self.config.block_tokens}, cache={q})"
+            f"{self.config.block_tokens}, cache={q}, "
+            f"guard={'on' if self._guard else 'off'})"
         )
 
     # -- scheduler internals ------------------------------------------------
 
-    def _next_key(self):
-        self._key, sub = jax.random.split(self._key)
-        return sub
+    def _tick_body(self) -> int:
+        self._enforce_deadlines(time.monotonic())
+        # growth first: existing slots claim the blocks their next write
+        # needs BEFORE admission can take them — the other order lets a
+        # fresh admission strand a grower, whose preempt-youngest victim
+        # is then the just-prefilled request (a full prefill thrown away
+        # per block boundary while the pool is tight)
+        self._grow()
+        produced = self._admit()
+        active = [(i, s) for i, s in enumerate(self._slots)
+                  if s is not None]
+        if active:
+            S = self.config.max_active
+            tokens = np.zeros((S,), np.int32)
+            pos = np.zeros((S,), np.int32)
+            seeds = np.zeros((S,), np.int32)
+            nprod = np.zeros((S,), np.int32)
+            poison = np.zeros((S,), np.float32)
+            tables = np.full((S, self.max_blocks_per_req), SCRATCH_BLOCK,
+                             np.int32)
+            for i, s in active:
+                tokens[i] = s.last
+                pos[i] = s.pos
+                seeds[i] = s.req.seed
+                nprod[i] = len(s.req.tokens)
+                tables[i, :len(s.table)] = s.table
+            if self._poison_pending:
+                for i in self._poison_pending:
+                    poison[i] = np.nan
+                self._poison_pending.clear()
+            t_dec = time.monotonic()
+            nxt, logits, bad, view = self._decode_fn(
+                self.params, self._stacked, self.pool.view,
+                tokens, pos, tables, seeds, nprod, poison,
+            )
+            self.pool.view = view
+            self.last_logits = logits
+            nxt = np.asarray(nxt)
+            # same computation, already synchronized by the token fetch
+            bad = np.asarray(bad)
+            tnow = time.monotonic()
+            self._gap_hist.append(tnow - t_dec)
+            poisoned = (set(self._guard.observe(bad, [i for i, _ in
+                                                      active]))
+                        if self._guard is not None else set())
+            for i, s in active:
+                if i in poisoned:
+                    self._quarantine(i, s)
+                    continue
+                t = int(nxt[i])
+                s.pos += 1
+                s.last = t
+                self._append_token(s.req, t, tnow)
+                if self.journal is not None:
+                    self.journal.tokens(s.req.id, [t])
+                produced += 1
+                if self._finished(s.req):
+                    self._finish(i, s)
+            if self._guard is not None and self._guard.should_restart:
+                self._warm_restart(
+                    f"{self._guard.consecutive_poisoned} consecutive "
+                    "poisoned decode ticks"
+                )
+        else:
+            # no decode step ran: a poison armed for this tick must not
+            # linger and hit whatever occupies the slot ticks later
+            self._poison_pending.clear()
+        return produced
+
+    def _gap_p50(self) -> Optional[float]:
+        """Median measured decode-tick wall — the inter-token service
+        price for deadline feasibility.  None until warm (a cold
+        engine's first walls are XLA compiles, not service time)."""
+        if len(self._gap_hist) < _MIN_GAP_SAMPLES:
+            return None
+        return float(np.median(np.asarray(self._gap_hist)))
+
+    def _enforce_deadlines(self, now: float) -> None:
+        """Shed queued requests that cannot meet their deadline; expire
+        active ones that already blew it."""
+        if self._queue and any(r.deadline is not None
+                               for r in self._queue):
+            gap = self._gap_p50()
+            keep: Deque[Request] = deque()
+            for req in self._queue:
+                dl = req.deadline
+                if dl is None:
+                    keep.append(req)
+                    continue
+                if now >= dl:
+                    self._shed_req(req, "deadline_overdue")
+                    continue
+                remaining = req.max_new_tokens - len(req.tokens)
+                # +1 tick for the prefill it still has to pay
+                if gap is not None and now + (remaining + 1) * gap > dl:
+                    self._shed_req(req, "deadline_unmeetable")
+                    continue
+                keep.append(req)
+            self._queue = keep
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            dl = s.req.deadline
+            if dl is not None and now > dl:
+                self._expire(i, s)
 
     def _bucket(self, p: int) -> int:
         """Prefill pad length: the smallest power-of-two multiple of
@@ -349,6 +645,14 @@ class ServingEngine:
             if ids is None:
                 break
             self._queue.popleft()
+            if self._prefill_exc is not None:
+                # chaos: the prefill "fails"; put everything back the
+                # way a real mid-admission fault would find it and let
+                # the watchdog take it from here
+                exc, self._prefill_exc = self._prefill_exc, None
+                self.pool.free_blocks(ids)
+                self._queue.appendleft(req)
+                raise exc
             t_adm = time.monotonic()
             if req.t_admitted is None:
                 req.t_admitted = t_adm
@@ -363,7 +667,8 @@ class ServingEngine:
             block_ids[:k] = ids[:k]
             nxt, view = self._prefill_fn(
                 self.params, self._stacked, padded, p - 1, block_ids,
-                self.pool.view, self._next_key(),
+                self.pool.view, np.int32(req.seed),
+                np.int32(len(req.tokens)),
             )
             self.pool.view = view
             tok = int(np.asarray(nxt)[0])
@@ -373,6 +678,8 @@ class ServingEngine:
             req.state = "active"
             self._count("serve_admissions")
             self._append_token(req, tok, time.monotonic())
+            if self.journal is not None:
+                self.journal.tokens(req.id, [tok])
             produced += 1
             if self._finished(req):
                 self._finish(slot_i, slot)
@@ -407,9 +714,47 @@ class ServingEngine:
         req.active_s += time.monotonic() - slot.admitted_at
         req.preemptions += 1
         # front of the queue: it resumes (re-prefilling prompt + tokens
-        # so far — greedy-exact continuation) as soon as blocks free up
+        # so far — an exact continuation under the (seed, position)
+        # sampling keys) as soon as blocks free up
         self._queue.appendleft(req)
         self._count("serve_preemptions")
+
+    def _warm_restart(self, reason: str) -> None:
+        """Watchdog escalation: rebuild the pool and slot array, keep
+        the compiled programs (same shapes/dtypes — no recompile),
+        re-queue every in-flight request front-of-line with its
+        produced prefix.  Raises after repeated restarts with zero
+        progress between them — a fault the restart cannot clear must
+        surface, not spin."""
+        self._restarts += 1
+        self._restarts_since_progress += 1
+        if self._restarts_since_progress > 5:
+            raise RuntimeError(
+                f"serving engine warm-restarted "
+                f"{self._restarts_since_progress} times without "
+                f"producing a token (last reason: {reason}) — the fault "
+                "is persistent; refusing to spin"
+            )
+        self._count("serve_restarts")
+        now = time.monotonic()
+        # oldest admission ends up frontmost (appendleft in reverse)
+        occupied = sorted(
+            ((i, s) for i, s in enumerate(self._slots) if s is not None),
+            key=lambda js: js[1].admitted_at, reverse=True,
+        )
+        for i, s in occupied:
+            s.req.state = "queued"
+            s.req.active_s += now - s.admitted_at
+            s.req.preemptions += 1
+            self._queue.appendleft(s.req)
+        self._slots = [None] * self.config.max_active
+        self._poison_pending.clear()
+        self.pool = PagedKVPool(**self._pool_args)
+        if self._guard is not None:
+            self._guard.reset()
+        if self.logger is not None:
+            self.logger.log_meta(kind="fault", fault="serve_restart",
+                                 at_step=self._ticks, action=reason)
 
     def _finished(self, req: Request) -> bool:
         if len(req.tokens) >= req.max_new_tokens:
@@ -425,31 +770,66 @@ class ServingEngine:
         req = slot.req
         self.pool.free_blocks(slot.table)
         self._slots[i] = None
-        req.state = "done"
-        req.t_done = time.monotonic()
         self._evictions += 1
         self._count("serve_evictions")
+        req.active_s += time.monotonic() - slot.admitted_at
+        self._terminal(req, "ok", req.finish_reason or "length")
+
+    def _expire(self, i: int, slot: _Slot) -> None:
+        req = slot.req
+        self.pool.free_blocks(slot.table)
+        self._slots[i] = None
+        self._expired += 1
+        self._count("serve_expired")
+        req.active_s += time.monotonic() - slot.admitted_at
+        self._terminal(req, "expired", "deadline")
+
+    def _quarantine(self, i: int, slot: _Slot) -> None:
+        req = slot.req
+        self.pool.free_blocks(slot.table)
+        self._slots[i] = None
+        self._quarantined += 1
+        self._count("serve_quarantined")
+        req.active_s += time.monotonic() - slot.admitted_at
+        self._terminal(req, "failed", "nonfinite_logits")
+
+    def _shed_req(self, req: Request, reason: str) -> None:
+        self._shed += 1
+        self._count("serve_shed")
+        self._terminal(req, "shed", f"shed:{reason}")
+
+    def _terminal(self, req: Request, status: str, finish: str) -> None:
+        """The ONE exit for every request outcome: state, journal end
+        line, JSONL `request` record with the terminal `status`."""
+        req.state = "done"
+        req.status = status
+        req.finish_reason = finish
+        req.t_done = time.monotonic()
+        if self.journal is not None and req._journaled:
+            self.journal.end(req.id, status, finish)
         if self.logger is not None:
-            self.logger.log_meta(
-                kind="request",
+            rec = dict(
                 request_id=req.id,
                 prompt_tokens=len(req.prompt),
                 new_tokens=len(req.tokens),
-                queue_s=round(req.t_admitted - req.t_arrival, 6),
-                ttft_s=round(req.t_first - req.t_arrival, 6),
-                # rate over the ACTIVE windows only (each admission ->
-                # preemption/done: prefill + decode), not the request
-                # lifetime — queue waits (initial AND re-queued after
-                # preemption) are reported by queue_s/preemptions, and
-                # folding them in here would collapse this field into a
-                # duplicate of overall latency
-                decode_tokens_per_s=round(
-                    len(req.tokens)
-                    / max(req.active_s
-                          + (req.t_done - slot.admitted_at), 1e-9), 3),
                 preemptions=req.preemptions,
-                finish=req.finish_reason or "length",
+                status=status,
+                finish=finish,
             )
+            if req.deadline_s is not None:
+                rec["deadline_s"] = req.deadline_s
+            if req.t_admitted is not None:
+                rec["queue_s"] = round(req.t_admitted - req.t_arrival, 6)
+            if req.t_first is not None:
+                rec["ttft_s"] = round(req.t_first - req.t_arrival, 6)
+            if req.tokens and req.active_s > 0:
+                # rate over the ACTIVE windows only (each admission ->
+                # preemption/terminal: prefill + decode) — queue waits
+                # are reported by queue_s/preemptions, and folding them
+                # in would collapse this into a duplicate of latency
+                rec["decode_tokens_per_s"] = round(
+                    len(req.tokens) / max(req.active_s, 1e-9), 3)
+            self.logger.log_meta(kind="request", **rec)
 
     def _append_token(self, req: Request, tok: int, tnow: float) -> None:
         # per-token latency = gap since the previous token's completion
@@ -468,9 +848,9 @@ class ServingEngine:
                 req.token_lat[-1])
         self._count("serve_tokens")
 
-    def _count(self, name: str) -> None:
+    def _count(self, name: str, n: int = 1) -> None:
         if self.telemetry is not None:
-            self.telemetry.counter(name).inc()
+            self.telemetry.counter(name).inc(n)
 
     def _update_gauges(self) -> None:
         if self.telemetry is None:
@@ -483,3 +863,7 @@ class ServingEngine:
         t.gauge("serve_queue_depth", float(len(self._queue)))
         t.gauge("serve_eviction_rate",
                 self._evictions / max(1, self._ticks))
+        t.gauge("serve_shed", float(self._shed))
+        t.gauge("serve_expired", float(self._expired))
+        t.gauge("serve_quarantined", float(self._quarantined))
+        t.gauge("serve_restarts", float(self._restarts))

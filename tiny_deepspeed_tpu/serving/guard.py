@@ -1,0 +1,68 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Decode-health guard: quarantine poisoned slots, watchdog the engine.
+
+A non-finite decode logit means a request's next token is garbage — and
+with continuous batching one poisoned request must not take the other
+`max_active - 1` down with it.  The check is CHEAP by construction: the
+compiled decode step already reduces each slot's logits to a per-slot
+`bad` flag on device ((S,) bool, `~all(isfinite(logits), -1)`), and the
+host reads it off the SAME computation it already fetches the sampled
+tokens from — no extra device sync, no second program.
+
+Containment is per-slot: a poisoned slot's request is marked `failed`
+(terminal status; its blocks return to the pool) while every other slot
+keeps serving — slots are independent in the paged attention (per-slot
+panels, per-slot masks), and masked reads of a freed block's stale NaNs
+resolve through `jnp.where(mask, att, -inf)` before the softmax, so a
+later owner of those blocks is untouched (pinned by the quarantine-storm
+pool-accounting test).
+
+The watchdog is the escalation path: K CONSECUTIVE poisoned ticks, or an
+exception out of a tick's compiled step, means the fault is not one bad
+request but the engine itself (poisoned weights, a wedged pool view) —
+the engine then warm-restarts: fresh pool + slot array, every in-flight
+request re-queued front-of-line with its produced prefix (the preemption
+resume path, so greedy requests continue token-exact), compiled programs
+kept (same shapes/dtypes — no recompile).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class DecodeHealthGuard:
+    """Per-tick decode-health bookkeeping.
+
+    `observe(bad, active)` takes the decode step's per-slot non-finite
+    flags and the active slot indices, returns the slot indices to
+    quarantine (only ACTIVE slots — invalid slots compute on scratch
+    garbage by design and their flags mean nothing).  `should_restart`
+    latches after `k_restart` consecutive poisoned ticks; the engine
+    calls `reset()` after the warm restart it triggers."""
+
+    def __init__(self, k_restart: int = 3):
+        if k_restart < 1:
+            raise ValueError("k_restart must be >= 1")
+        self.k_restart = int(k_restart)
+        self.consecutive_poisoned = 0
+        self.quarantined_total = 0
+
+    def observe(self, bad: Sequence[bool],
+                active: Sequence[int]) -> List[int]:
+        poisoned = [i for i in active if bool(bad[i])]
+        if poisoned:
+            self.consecutive_poisoned += 1
+            self.quarantined_total += len(poisoned)
+        else:
+            self.consecutive_poisoned = 0
+        return poisoned
+
+    @property
+    def should_restart(self) -> bool:
+        return self.consecutive_poisoned >= self.k_restart
+
+    def reset(self) -> None:
+        self.consecutive_poisoned = 0

@@ -1,0 +1,160 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Crash-recoverable request journal: the serving tier's write-ahead log.
+
+The engine loses every in-flight request when its process dies — the KV
+pool is gone, but the REQUESTS are replayable: under the (seed, position)
+sampling keys a request's token sequence is a pure function of (prompt,
+produced prefix), so re-prefilling prompt + produced continues exactly
+(the same mechanism preemption resume rides).  What recovery needs is
+just the host-side facts: which requests were admitted and which tokens
+each had produced — this module journals exactly that.
+
+Write discipline:
+
+  * every event is ONE JSONL line, written whole in a single `write()`
+    call (atomic at the line level — a crash can tear at most the final
+    line, and `replay` tolerates a torn tail);
+  * lines buffer in memory during a scheduler tick and `commit()` writes
+    + flushes + fsyncs them once per tick — one fsync per tick, not one
+    per token (the "fsync batched per tick" contract).  Tokens produced
+    after the last commit are LOST from the journal on a crash; recovery
+    simply re-decodes them to the same values.
+
+Event lines:
+
+    {"ev": "submit", "id": 3, "prompt": [...], "max_new": 16,
+     "deadline_s": null, "seed": 3}
+    {"ev": "tok", "id": 3, "toks": [41, 7]}
+    {"ev": "end", "id": 3, "status": "ok", "finish": "length"}
+
+`replay()` folds a journal back into (pending requests in admission
+order, finished ids): a request with an "end" line is done; everything
+else is interrupted and re-queues front-of-line with its produced
+prefix (`ServingEngine.recover`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+class ServingKilled(RuntimeError):
+    """Simulated process death between journal-append and commit — the
+    chaos harness's stand-in for a SIGKILL at the worst write moment
+    (resilience/chaos.py).  The engine must NOT catch this and warm-
+    restart: a real kill leaves no engine to restart; recovery happens
+    in the next process via `ServingEngine.recover`."""
+
+
+class RequestJournal:
+    """Append-only JSONL journal of admissions and produced tokens."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        # append mode: recovery continues the SAME file, so a second
+        # crash replays both segments
+        self._fh = open(self.path, "a")
+        self._buf: List[str] = []
+        # test hook: called in commit() after lines are handed to the
+        # buffer but before they reach the file — where a kill hurts most
+        self._commit_hook = None
+
+    # -- append (buffered; atomic single-write lines) -----------------------
+
+    def _append(self, rec: Dict) -> None:
+        self._buf.append(json.dumps(rec) + "\n")
+
+    def submit(self, req) -> None:
+        self._append({
+            "ev": "submit", "id": req.id, "prompt": list(req.prompt),
+            "max_new": req.max_new_tokens, "deadline_s": req.deadline_s,
+            "seed": req.seed,
+        })
+
+    def tokens(self, req_id: int, toks: List[int]) -> None:
+        if toks:
+            self._append({"ev": "tok", "id": req_id,
+                          "toks": [int(t) for t in toks]})
+
+    def end(self, req_id: int, status: str, finish: str) -> None:
+        self._append({"ev": "end", "id": req_id, "status": status,
+                      "finish": finish})
+
+    def commit(self) -> None:
+        """Write every buffered line (one write() per line), flush, and
+        fsync — called once per scheduler tick."""
+        if self._commit_hook is not None:
+            hook, self._commit_hook = self._commit_hook, None
+            hook()
+        if not self._buf:
+            return
+        for line in self._buf:
+            self._fh.write(line)
+        self._buf = []
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def arm_commit_hook(self, fn) -> None:
+        """Install a ONE-SHOT hook that runs at the next commit() before
+        any buffered line reaches the file.  The chaos harness raises
+        ServingKilled here (the buffered tick is lost, exactly like a
+        kill between append and fsync); the kill-mid-trace worker calls
+        os.kill(pid, SIGKILL) for the real thing."""
+        self._commit_hook = fn
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self.commit()
+            self._fh.close()
+            self._fh = None
+
+    # -- replay -------------------------------------------------------------
+
+    @staticmethod
+    def replay(path: str) -> Tuple[List[Dict], List[int]]:
+        """Fold a journal into (interrupted, finished_ids).
+
+        `interrupted` is a list of {"id", "prompt", "max_new",
+        "deadline_s", "seed", "tokens"} dicts in ADMISSION order — each
+        an in-flight request at crash time with the token prefix the
+        journal had committed.  A torn final line (the crash landed
+        mid-write) is skipped; a torn line anywhere else is a corrupt
+        journal and raises."""
+        reqs: Dict[int, Dict] = {}
+        done: List[int] = []
+        with open(path) as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if i == len(lines) - 1:
+                    break  # torn tail: the crash interrupted this write
+                raise ValueError(
+                    f"{path}: corrupt journal line {i + 1} (not the "
+                    "final line — this is not a torn-tail crash "
+                    "artifact)"
+                )
+            ev, rid = rec.get("ev"), rec.get("id")
+            if ev == "submit":
+                reqs[rid] = {
+                    "id": rid, "prompt": rec["prompt"],
+                    "max_new": rec["max_new"],
+                    "deadline_s": rec.get("deadline_s"),
+                    "seed": rec.get("seed", rid), "tokens": [],
+                }
+            elif ev == "tok" and rid in reqs:
+                reqs[rid]["tokens"].extend(rec["toks"])
+            elif ev == "end" and rid in reqs:
+                done.append(rid)
+                del reqs[rid]
+        return list(reqs.values()), done

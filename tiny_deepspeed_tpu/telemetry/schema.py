@@ -37,8 +37,12 @@ _NUM = (int, float)
 #   3: + resume / fault meta kinds (resilience subsystem: elastic resume
 #      reports, chaos fault-injection log) and checkpoint gauges
 #   4: + request meta kind (serving tier per-request latency records)
-#      and the serve_* gauges (this PR)
-SCHEMA_VERSION = 4
+#      and the serve_* gauges
+#   5: + serving robustness: request records carry the terminal `status`
+#      (ok/shed/expired/failed) + optional deadline_s; fault records may
+#      carry a `slot`; serve_shed / serve_expired / serve_quarantined /
+#      serve_restarts gauges (this PR)
+SCHEMA_VERSION = 5
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -144,14 +148,18 @@ META_FIELDS: Dict[str, tuple] = {
     "moved_params": int,
     "data": dict,
     "checkpoint_dir": str,
-    # fault record (resilience/chaos.py fault log + rebalance events)
+    # fault record (resilience/chaos.py fault log + rebalance events;
+    # serving tick faults name the poisoned decode slot, and the
+    # engine's warm-restart event rides the same kind)
     "fault": str,
     "at_step": int,
     "path": str,
     "attempts": int,
     "action": str,
     "shares": list,
-    # request record (serving tier, one per finished request)
+    "slot": int,
+    # request record (serving tier, one per TERMINAL request — every
+    # outcome writes one, not just clean finishes)
     "request_id": int,
     "prompt_tokens": int,
     "new_tokens": int,
@@ -159,7 +167,14 @@ META_FIELDS: Dict[str, tuple] = {
     "ttft_s": _NUM,            # arrival -> first token
     "decode_tokens_per_s": _NUM,
     "preemptions": int,
-    "finish": str,             # "length" | "eos"
+    # terminal outcome: "ok" (served), "shed" (refused/unmeetable before
+    # service), "expired" (blew its deadline mid-service), "failed"
+    # (quarantined on non-finite decode logits)
+    "status": str,
+    # detail under the status: "length" | "eos" | "deadline" |
+    # "nonfinite_logits" | "shed:<watermark-or-deadline reason>"
+    "finish": str,
+    "deadline_s": _NUM,        # the request's SLO, echoed when set
 }
 
 
@@ -300,4 +315,14 @@ GAUGES: Dict[str, str] = {
                          "tick",
     "serve_eviction_rate": "finished-request evictions per scheduler "
                            "tick, cumulative",
+    "serve_shed": "requests shed before service (admission-watermark "
+                  "refusals + deadline-unmeetable queue sheds), "
+                  "cumulative",
+    "serve_expired": "active requests evicted for blowing their "
+                     "deadline, cumulative",
+    "serve_quarantined": "decode slots quarantined on non-finite "
+                         "logits (request -> failed), cumulative",
+    "serve_restarts": "engine warm restarts tripped by the decode-"
+                      "health watchdog (consecutive poisoned ticks or "
+                      "a tick exception), cumulative",
 }
