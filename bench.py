@@ -798,6 +798,10 @@ def run_serve(model_name: str, b=None, t=None):
             "p50_token_latency_ms": res["token_latency"]["p50_ms"],
             "p99_token_latency_ms": res["token_latency"]["p99_ms"],
             "ttft_p50_ms": res["ttft"]["p50_ms"],
+            # where the trace's request-seconds went (queue/prefill/
+            # decode/preempt/restart — serving/driver.py aggregate of
+            # the per-request latency partition)
+            "latency_components_s": res["latency_components_s"],
             "occupancy": res["mean_occupancy"],
             "pool_utilization": res["mean_pool_utilization"],
             "pool_kv_bytes": eng.pool.kv_bytes()["kv_block_bytes"],

@@ -287,6 +287,49 @@ def render_report(metas: List[dict], steps: List[dict],
         )
         out.append("")
 
+    # -- serving tier -------------------------------------------------------
+    req_recs = [m for m in metas if m.get("kind") == "request"]
+    tick_recs = [m for m in metas if m.get("kind") == "tick"]
+    if req_recs or tick_recs:
+        out.append("## Serving\n")
+        by_status = {}
+        for r in req_recs:
+            s = r.get("status", "?")
+            by_status[s] = by_status.get(s, 0) + 1
+        if req_recs:
+            out.append(f"- requests: {len(req_recs)} (" + ", ".join(
+                f"{k} {v}" for k, v in sorted(by_status.items())) + ")")
+            ttfts = sorted(
+                r["ttft_s"] for r in req_recs
+                if isinstance(r.get("ttft_s"), (int, float)))
+            if ttfts:
+                out.append(
+                    f"- TTFT: p50 {_quantile(ttfts, 0.5) * 1e3:.1f} ms, "
+                    f"p99 {_quantile(ttfts, 0.99) * 1e3:.1f} ms"
+                )
+            lats = sorted(
+                r["lat_s"] for r in req_recs
+                if isinstance(r.get("lat_s"), (int, float))
+                and r.get("status") != "shed")
+            if lats:
+                out.append(
+                    f"- latency: p50 {_quantile(lats, 0.5) * 1e3:.1f} ms"
+                    f", p99 {_quantile(lats, 0.99) * 1e3:.1f} ms"
+                )
+        if tick_recs:
+            occ = [t["occupancy"] for t in tick_recs
+                   if isinstance(t.get("occupancy"), (int, float))]
+            if occ:
+                out.append(
+                    f"- ticks recorded: {len(tick_recs)}, mean "
+                    f"occupancy {sum(occ) / len(occ):.2f}"
+                )
+        out.append(
+            "\nFull dashboard (tail attribution, SLO headroom, shed "
+            f"audit): `python scripts/serve_report.py "
+            f"{source or 'RUN.jsonl'}`\n"
+        )
+
     # -- telemetry registry summary ----------------------------------------
     if summary:
         out.append("## Telemetry registry\n")

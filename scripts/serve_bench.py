@@ -22,11 +22,21 @@ per-token latency — the serving headline the ROADMAP asks for.
         --closed-loop --chaos "nan@6,nan@7,delay@10" --deadline 30
 
 Prints a human summary plus ONE machine-readable JSON line (the same
-shape bench.py's BENCH_SERVE record embeds in `extra`); --jsonl writes
-the per-request `request` records + telemetry summary through the
-standard metrics schema (render with scripts/report_run.py).  With
---chaos the JSON carries both passes plus the terminal-status counts
-(ok/shed/expired/failed) and p99 TTFT with and without faults."""
+shape bench.py's BENCH_SERVE record embeds in `extra`).
+
+Every run writes a telemetry JSONL SIDECAR (default
+artifacts/serve_run.jsonl; --jsonl PATH moves it, --jsonl none disables)
+the same way bench.py does: a run_meta record carrying the serve config,
+per-tick `tick` records, per-request `request` records with lifecycle
+events + latency components, flight records on faults, and the
+telemetry summary — so every bench run replays in the dashboard
+(`scripts/serve_report.py`, `scripts/report_run.py`) and the trace
+viewer (`scripts/trace_view.py` -> Perfetto slot/queue tracks).  With
+--chaos the faulted pass writes its OWN sidecar next to the clean one
+(<path>.chaos.jsonl) with its own telemetry registry, so the A/B is two
+replayable files, and the JSON summary carries both passes plus the
+terminal-status counts (ok/shed/expired/failed) and p99 TTFT with and
+without faults."""
 
 import argparse
 import json
@@ -84,9 +94,19 @@ def main(argv=None) -> int:
                    help="also run the one-at-a-time generate() baseline "
                         "on the same trace and report the ratio")
     p.add_argument("--jsonl", default=None, metavar="PATH",
-                   help="write per-request records + telemetry summary "
-                        "as a metrics JSONL stream")
+                   help="telemetry JSONL sidecar (run_meta + tick + "
+                        "request records + flight/telemetry summary; "
+                        "default: artifacts/serve_run.jsonl beside the "
+                        "repo, 'none' disables)")
     args = p.parse_args(argv)
+
+    jsonl_path = args.jsonl
+    if jsonl_path is None:
+        jsonl_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..",
+            "artifacts", "serve_run.jsonl")
+    elif jsonl_path.lower() == "none":
+        jsonl_path = None
 
     if args.cpu:
         import jax
@@ -110,16 +130,6 @@ def main(argv=None) -> int:
     )
 
     tel = Telemetry()
-    logger = None
-    if args.jsonl:
-        from tiny_deepspeed_tpu.telemetry.schema import SCHEMA_VERSION
-        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
-        if os.path.exists(args.jsonl):
-            os.remove(args.jsonl)
-        logger = MetricsLogger(args.jsonl, stdout=False)
-        logger.log_meta(schema_version=SCHEMA_VERSION,
-                        engine=f"serve:{args.model}",
-                        model=args.model, devices=jax.device_count())
 
     bt = args.block_tokens
     max_seq = args.max_seq_tokens or min(
@@ -136,10 +146,36 @@ def main(argv=None) -> int:
     )
     realtime = not args.closed_loop and args.rate is not None
 
+    def make_logger(path):
+        """Sidecar writer: run_meta first (schema stamp + the serve
+        geometry trace_view.py lays slot tracks out from), the engine
+        streams tick/request/flight records behind it."""
+        if not path:
+            return None
+        from tiny_deepspeed_tpu.telemetry.schema import SCHEMA_VERSION
+        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
+        if os.path.exists(path):
+            os.remove(path)
+        lg = MetricsLogger(path, stdout=False)
+        lg.log_meta(schema_version=SCHEMA_VERSION,
+                    engine=f"serve:{args.model}",
+                    model=args.model, devices=jax.device_count(),
+                    serve=dict(
+                        max_active=args.max_active,
+                        num_blocks=args.num_blocks, block_tokens=bt,
+                        max_seq_tokens=max_seq,
+                        quant=args.kv_quant or "off",
+                    ))
+        return lg
+
+    # CLI validation BEFORE the sidecar writer truncates anything: an
+    # invalid invocation must not destroy the previous run's records
     if args.chaos and "journal_kill" in args.chaos and not args.journal:
         p.error("--chaos journal_kill@N needs --journal PATH (the kill "
                 "fires inside the journal's commit, and recovery "
                 "replays it)")
+
+    logger = make_logger(jsonl_path)
 
     # warm run on the SAME engine (each engine owns fresh jit closures,
     # so warming a throwaway one buys nothing): one request per DISTINCT
@@ -181,6 +217,7 @@ def main(argv=None) -> int:
         "restarts": res["restarts"],
         "token_latency": res["token_latency"],
         "ttft": res["ttft"],
+        "latency_components_s": res["latency_components_s"],
         "mean_occupancy": res["mean_occupancy"],
         "mean_pool_utilization": res["mean_pool_utilization"],
         "evictions": res["evictions"],
@@ -197,8 +234,17 @@ def main(argv=None) -> int:
         from tiny_deepspeed_tpu.serving import ServingKilled
         chaos = parse_serving_chaos(args.chaos, seed=args.seed,
                                     delay_s=args.chaos_delay_s)
+        # the faulted pass gets its OWN sidecar + telemetry registry:
+        # two replayable files (clean vs chaos) make the A/B a pair of
+        # serve_report.py dashboards instead of one entangled stream
+        chaos_jsonl = None
+        if jsonl_path:
+            root, ext = os.path.splitext(jsonl_path)
+            chaos_jsonl = f"{root}.chaos{ext or '.jsonl'}"
+        tel2 = Telemetry()
+        logger2 = make_logger(chaos_jsonl)
         ceng = ChaosServingEngine(warmed_engine(), chaos)
-        ceng.engine.telemetry, ceng.engine.logger = tel, logger
+        ceng.engine.telemetry, ceng.engine.logger = tel2, logger2
         try:
             cres = run_trace(ceng, trace, realtime=realtime)
         except ServingKilled:
@@ -208,6 +254,7 @@ def main(argv=None) -> int:
             # requests (arrivals not yet submitted died with the
             # process, exactly as a real crash loses them)
             reng = warmed_engine()
+            reng.telemetry, reng.logger = tel2, logger2
             rec = reng.recover()
             reng.drain()
             cres = None
@@ -219,8 +266,12 @@ def main(argv=None) -> int:
                                     if r.status == "ok"),
             }
         n_faults = len(chaos.injected)
-        if logger is not None:
-            chaos.log_faults(logger)
+        if logger2 is not None:
+            chaos.log_faults(logger2)
+            tel2.flush(logger2)
+            logger2.close()
+            print(f"chaos-pass records -> {chaos_jsonl}",
+                  file=sys.stderr)
         if cres is not None:
             summary["chaos"] = {
                 "spec": args.chaos,
@@ -278,7 +329,11 @@ def main(argv=None) -> int:
     if logger is not None:
         tel.flush(logger)
         logger.close()
-        print(f"request records -> {args.jsonl}", file=sys.stderr)
+        print(
+            f"sidecar -> {jsonl_path}  (dashboard: python "
+            f"scripts/serve_report.py {jsonl_path}; timeline: python "
+            f"scripts/trace_view.py {jsonl_path})", file=sys.stderr,
+        )
     return 0
 
 

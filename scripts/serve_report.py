@@ -1,0 +1,352 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Render a serving run's metrics JSONL into a markdown dashboard:
+tail-latency ATTRIBUTION, not just percentiles.
+
+    python scripts/serve_report.py RUN.jsonl [-o REPORT.md]
+
+The JSONL comes from `scripts/serve_bench.py`'s sidecar (or any
+`ServingEngine` run with a MetricsLogger attached); the record schema is
+`tiny_deepspeed_tpu/telemetry/schema.py` (v6: per-request latency
+components + per-tick time series).  The dashboard answers the
+operational questions the percentile headline cannot:
+
+  * p50/p95/p99 TTFT and end-to-end latency — and, for the requests in
+    the p99 latency tail, WHICH component they paid (queue-wait /
+    prefill / decode-active / preempted-wait / restart-overhead): a
+    quarantine-induced p99 names restart-overhead, an overload-induced
+    one names queue-wait.
+  * SLO headroom histogram (deadline - latency, served requests only):
+    how close the tier ran to its promises, violations included.
+  * shed-reason audit: watermark refusals vs deadline-overdue vs
+    deadline-unmeetable — the three mean different capacity actions
+    (raise the pool / fix arrival bursts / fix the SLO).
+  * goodput over a rolling window (tokens of "ok" requests per second),
+    min/mean/max — a restart shows up as the min-window dip even when
+    the whole-run average looks fine.
+  * per-tick time series summary: tick-wall split (host scheduling vs
+    prefill vs decode dispatch vs token fetch), occupancy / pool /
+    queue-depth ranges, and the fault counters.
+
+Exit codes: 0 ok; 1 parse errors in the JSONL (partial report rendered);
+2 missing/empty input or no serving records at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+from typing import Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# latency-component record fields -> dashboard labels, in partition order
+COMPONENTS = (
+    ("comp_queue_s", "queue-wait"),
+    ("comp_prefill_s", "prefill"),
+    ("comp_decode_s", "decode-active"),
+    ("comp_preempt_s", "preempted-wait"),
+    ("comp_restart_s", "restart-overhead"),
+)
+
+
+def _load_trace_module():
+    """telemetry/trace.py by file path (same trick as trace_view.py):
+    the loader is pure-python and the dashboard must not pay a jax
+    import to reshuffle JSONL."""
+    spec = importlib.util.spec_from_file_location(
+        "tiny_deepspeed_tpu_trace_for_serve_report",
+        os.path.join(_REPO, "tiny_deepspeed_tpu", "telemetry", "trace.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace = _load_trace_module()
+# ONE quantile implementation for the jax-free scripts (the loaded
+# trace module's copy) — report_run.py's percentiles come from the same
+# formula via utils/profiling._quantile
+_quantile = trace._quantile
+
+
+def _ms(s: float) -> str:
+    return f"{s * 1e3:.1f} ms"
+
+
+def _pcts(xs: List[float]) -> str:
+    return (f"p50 {_ms(_quantile(xs, 0.5))}, "
+            f"p95 {_ms(_quantile(xs, 0.95))}, "
+            f"p99 {_ms(_quantile(xs, 0.99))}, "
+            f"max {_ms(max(xs))}")
+
+
+def _histogram_ascii(xs: List[float], bins: int = 8,
+                     width: int = 24) -> List[str]:
+    """Small fixed-width ASCII histogram (markdown code block lines)."""
+    lo, hi = min(xs), max(xs)
+    if hi <= lo:
+        hi = lo + 1e-9
+    counts = [0] * bins
+    for x in xs:
+        i = min(bins - 1, int((x - lo) / (hi - lo) * bins))
+        counts[i] += 1
+    peak = max(counts) or 1
+    out = []
+    for i, c in enumerate(counts):
+        b0 = lo + (hi - lo) * i / bins
+        b1 = lo + (hi - lo) * (i + 1) / bins
+        bar = "#" * max(1 if c else 0, round(c / peak * width))
+        out.append(f"[{b0 * 1e3:+9.1f}, {b1 * 1e3:+9.1f}) ms "
+                   f"{bar:<{width}} {c}")
+    return out
+
+
+def render_serve_report(metas: List[dict], source: str = "") -> str:
+    run = next((m for m in metas if m.get("kind") == "run_meta"), {})
+    reqs = [m for m in metas if m.get("kind") == "request"]
+    ticks = [m for m in metas if m.get("kind") == "tick"]
+    out: List[str] = []
+    title = run.get("model") or run.get("engine") \
+        or os.path.basename(source) or "serving run"
+    out.append(f"# Serving report — {title}\n")
+    if source:
+        out.append(f"Source: `{source}`\n")
+
+    if run:
+        out.append("## Run\n")
+        for label, key in (("engine", "engine"), ("model", "model"),
+                           ("devices", "devices")):
+            if key in run:
+                out.append(f"- {label}: {run[key]}")
+        serve = run.get("serve") or {}
+        if serve:
+            out.append("- serve config: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(serve.items())))
+        out.append("")
+
+    # -- outcomes -----------------------------------------------------------
+    by_status: Dict[str, int] = {}
+    for r in reqs:
+        by_status[r.get("status", "?")] = \
+            by_status.get(r.get("status", "?"), 0) + 1
+    out.append("## Requests\n")
+    out.append(f"- terminal records: {len(reqs)} (" + ", ".join(
+        f"{k} {v}" for k, v in sorted(by_status.items())) + ")")
+    toks = sum(r.get("new_tokens", 0) for r in reqs)
+    ok_toks = sum(r.get("new_tokens", 0) for r in reqs
+                  if r.get("status") == "ok")
+    out.append(f"- tokens produced: {toks} ({ok_toks} to requests that "
+               "finished ok)")
+    preempts = sum(r.get("preemptions", 0) for r in reqs)
+    if preempts:
+        out.append(f"- preemptions: {preempts}")
+    out.append("")
+
+    # -- latency + tail attribution ----------------------------------------
+    served = [r for r in reqs if r.get("status") != "shed"
+              and isinstance(r.get("lat_s"), (int, float))]
+    ttfts = [r["ttft_s"] for r in reqs
+             if isinstance(r.get("ttft_s"), (int, float))]
+    if ttfts:
+        out.append("## Latency\n")
+        out.append(f"- TTFT: {_pcts(ttfts)}")
+    if served:
+        lats = [r["lat_s"] for r in served]
+        out.append(f"- end-to-end latency (served requests): "
+                   f"{_pcts(lats)}")
+        out.append("")
+        p99 = _quantile(lats, 0.99)
+        tail = [r for r in served if r["lat_s"] >= p99] or \
+            [max(served, key=lambda r: r["lat_s"])]
+        out.append("### Tail attribution (p99 and above, "
+                   f"{len(tail)} request(s))\n")
+        out.append("What the slowest requests actually paid for — the "
+                   "components partition each request's latency "
+                   "(engine-pinned: they sum to lat_s), so the biggest "
+                   "share IS the cause:\n")
+        out.append("| component | tail mean | tail share | all-request "
+                   "p99 |")
+        out.append("|---|---|---|---|")
+        tail_lat = sum(r["lat_s"] for r in tail) or 1e-9
+        shares = []
+        for key, label in COMPONENTS:
+            tot = sum(float(r.get(key, 0.0)) for r in tail)
+            all_p99 = _quantile(
+                [float(r.get(key, 0.0)) for r in served], 0.99)
+            shares.append((tot / tail_lat, label, tot, all_p99))
+        for share, label, tot, all_p99 in sorted(shares, reverse=True):
+            out.append(f"| {label} | {_ms(tot / len(tail))} | "
+                       f"{share:.0%} | {_ms(all_p99)} |")
+        top = max(shares)
+        out.append(
+            f"\np99 verdict: **{top[1]}** dominates the tail "
+            f"({top[0]:.0%} of tail latency).\n"
+        )
+
+    # -- SLO headroom -------------------------------------------------------
+    slo = [(float(r["deadline_s"]) - float(r["lat_s"])) for r in served
+           if isinstance(r.get("deadline_s"), (int, float))]
+    if slo:
+        viol = sum(1 for h in slo if h < 0)
+        out.append("## SLO headroom (deadline − latency, served "
+                   "requests)\n")
+        out.append(f"- requests with deadlines: {len(slo)}, violations "
+                   f"(negative headroom): {viol}")
+        out.append(f"- headroom: {_pcts(sorted(slo))}")
+        out.append("\n```")
+        out.extend(_histogram_ascii(slo))
+        out.append("```\n")
+
+    # -- shed audit ---------------------------------------------------------
+    sheds: Dict[str, int] = {}
+    for r in reqs:
+        fin = str(r.get("finish", ""))
+        if r.get("status") == "shed" and fin.startswith("shed:"):
+            sheds[fin.split(":", 1)[1]] = \
+                sheds.get(fin.split(":", 1)[1], 0) + 1
+    if sheds:
+        out.append("## Shed audit\n")
+        out.append("| reason | count | what it means |")
+        out.append("|---|---|---|")
+        meaning = {
+            "queue_watermark": "admission refused at max_queue — "
+                               "sustained overload, add capacity",
+            "pool_watermark": "admission refused at pool pressure — "
+                              "KV pool too small for the traffic",
+            "deadline_overdue": "already past its deadline in queue — "
+                                "arrival bursts outran the SLO",
+            "deadline_unmeetable": "priced as unmeetable from the "
+                                   "measured decode tick — the SLO "
+                                   "asks more than the engine serves",
+        }
+        for reason, n in sorted(sheds.items(), key=lambda kv: -kv[1]):
+            out.append(f"| {reason} | {n} | "
+                       f"{meaning.get(reason, '?')} |")
+        out.append("")
+
+    # -- rolling goodput ----------------------------------------------------
+    done = sorted(
+        (float(r["ts"]), int(r.get("new_tokens", 0)))
+        for r in reqs if r.get("status") == "ok"
+        and isinstance(r.get("ts"), (int, float))
+    )
+    if len(done) >= 2 and done[-1][0] > done[0][0]:
+        span = done[-1][0] - done[0][0]
+        win = max(span / 8.0, 1e-6)
+        rates = []
+        t = done[0][0]
+        while t < done[-1][0]:
+            rates.append(sum(n for ts, n in done
+                             if t <= ts < t + win) / win)
+            t += win
+        out.append("## Goodput (ok-request tokens/s, rolling "
+                   f"{win:.2f}s windows)\n")
+        out.append(
+            f"- mean {sum(rates) / len(rates):.1f}, "
+            f"min {min(rates):.1f}, max {max(rates):.1f} tok/s "
+            "(a restart or shed burst shows as the min-window dip)"
+        )
+        out.append("")
+
+    # -- per-tick time series -----------------------------------------------
+    if ticks:
+        out.append("## Scheduler ticks\n")
+        out.append(f"- tick records: {len(ticks)} "
+                   f"({sum(1 for t in ticks if t.get('emit') == 'event')}"
+                   " eventful, rest sampled)")
+        walls = [t["wall_s"] for t in ticks
+                 if isinstance(t.get("wall_s"), (int, float))]
+        if walls:
+            out.append(f"- tick wall: {_pcts(walls)}")
+        segs = [("sched_s", "host scheduling"),
+                ("prefill_s", "prefill"),
+                ("decode_s", "decode dispatch"),
+                ("fetch_s", "token fetch")]
+        tot = sum(sum(float(t.get(k, 0.0)) for t in ticks)
+                  for k, _ in segs) or 1e-9
+        out.append("\n| tick segment | total | share |")
+        out.append("|---|---|---|")
+        for k, label in segs:
+            s = sum(float(t.get(k, 0.0)) for t in ticks)
+            out.append(f"| {label} | {s:.3f} s | {s / tot:.0%} |")
+        occ = [t["occupancy"] for t in ticks
+               if isinstance(t.get("occupancy"), (int, float))]
+        qd = [t["queue_depth"] for t in ticks
+              if isinstance(t.get("queue_depth"), int)]
+        out.append("")
+        if occ:
+            out.append(f"- occupancy: mean {sum(occ) / len(occ):.2f}, "
+                       f"min {min(occ):.2f}, max {max(occ):.2f}")
+        if qd:
+            out.append(f"- queue depth: max {max(qd)}")
+        faults = {k: sum(int(t.get(k, 0)) for t in ticks)
+                  for k in ("shed", "expired", "quarantined",
+                            "restarted")}
+        if any(faults.values()):
+            out.append("- fault counters: " + ", ".join(
+                f"{k} {v}" for k, v in faults.items() if v))
+        out.append("")
+
+    flights = [m for m in metas if m.get("kind") == "flight"
+               and str(m.get("reason", "")).startswith("serve_")]
+    if flights:
+        out.append("## Flight records\n")
+        for fl in flights:
+            out.append(
+                f"- `{fl.get('reason')}` at tick "
+                f"{fl.get('at_step', '?')}: "
+                f"{len(fl.get('steps') or [])} tick(s) of lead-up in "
+                "the ring"
+            )
+        out.append("")
+
+    out.append(
+        "Request timeline: `python scripts/trace_view.py "
+        f"{source or 'RUN.jsonl'}` -> Chrome-trace JSON "
+        "(chrome://tracing / Perfetto).\n"
+    )
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="metrics JSONL from a serving run")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the markdown report here "
+                         "(default: stdout)")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.jsonl):
+        print(f"{args.jsonl}: no such file", file=sys.stderr)
+        return 2
+    metas, _steps, errs = trace.load_run(args.jsonl)
+    for e in errs:
+        print(f"warning: {args.jsonl}: {e}", file=sys.stderr)
+    if not any(m.get("kind") in ("request", "tick") for m in metas):
+        print(
+            f"{args.jsonl}: no serving records (run serve_bench.py "
+            "with its sidecar, or attach a MetricsLogger to the "
+            "ServingEngine)", file=sys.stderr,
+        )
+        return 2
+    report = render_serve_report(metas, source=args.jsonl)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report)
+        print(f"wrote {args.out}")
+    else:
+        print(report)
+    if errs:
+        print(
+            f"{args.jsonl}: {len(errs)} unparseable line(s) — the "
+            "report covers only the valid records", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,23 +1,33 @@
 # Copyright 2026 tiny-deepspeed-tpu authors
 # SPDX-License-Identifier: Apache-2.0
 
-"""Export a training run's metrics JSONL as a Chrome-trace timeline.
+"""Export a run's metrics JSONL as a Chrome-trace timeline.
 
     python scripts/trace_view.py RUN.jsonl [-o TRACE.json]
 
-Load TRACE.json in chrome://tracing or https://ui.perfetto.dev.  The
-timeline shows, per step: the whole-step span, the measured host wall
-segments (data wait / host->device / device compute+sync — StepTimer
-`mark()`), and the compiled step's collective spans from the HLO ledger
-(`utils/hlo_comm.py`) instantiated inside the compute window — widths
-proportional to wire bytes (schematic), annotations exact: wire bytes,
-op count, per-dtype split, loop-resident flag.  Span assembly lives in
-`tiny_deepspeed_tpu/telemetry/trace.py`; the input comes from
-`examples/* --telemetry --metrics RUN.jsonl` (which also writes the
-`trace` span-template record) or `bench.py`'s telemetry sidecar.
+Load TRACE.json in chrome://tracing or https://ui.perfetto.dev.
+
+TRAINING runs show, per step: the whole-step span, the measured host
+wall segments (data wait / host->device / device compute+sync —
+StepTimer `mark()`), and the compiled step's collective spans from the
+HLO ledger (`utils/hlo_comm.py`) instantiated inside the compute window
+— widths proportional to wire bytes (schematic), annotations exact:
+wire bytes, op count, per-dtype split, loop-resident flag.
+
+SERVING runs (auto-detected from `request`/`tick` records — the
+`serve_bench.py` sidecar or any ServingEngine with a logger) show the
+scheduler ticks with their measured wall split, a queue track of
+request wait windows, and one track per decode slot with each request's
+active windows — preemptions, quarantines, and watchdog warm restarts
+visible as span boundaries and instant markers.
+
+Span assembly lives in `tiny_deepspeed_tpu/telemetry/trace.py`; the
+input comes from `examples/* --telemetry --metrics RUN.jsonl` (which
+also writes the `trace` span-template record), `bench.py`'s telemetry
+sidecar, or `scripts/serve_bench.py`'s sidecar.
 
 Exit codes: 0 ok; 1 parse errors in the JSONL; 2 missing/empty input or
-no timed step records to lay out.
+no timed step/tick/request records to lay out.
 """
 
 from __future__ import annotations
@@ -65,17 +75,37 @@ def main(argv=None) -> int:
         print(f"{args.jsonl}: no records (empty or fully truncated "
               "metrics file)", file=sys.stderr)
         return 2
-    doc = trace.chrome_trace(metas, steps, source=args.jsonl)
+    serving = trace.has_serving_records(metas)
+    timed_steps = any(
+        isinstance(r.get("ts"), (int, float))
+        and isinstance(r.get("step_s"), (int, float)) for r in steps
+    )
+    if serving and not timed_steps:
+        doc = trace.serving_chrome_trace(metas, source=args.jsonl)
+        laid_out = "tick(s)/request(s)"
+        n_laid = (doc["otherData"]["ticks"]
+                  + doc["otherData"]["requests"])
+    else:
+        doc = trace.chrome_trace(metas, steps, source=args.jsonl)
+        laid_out = "step(s)"
+        n_laid = len(steps)
+        if serving:
+            # a file carrying BOTH (a combined sidecar): serving tracks
+            # join the training timeline as their own process (pid 1)
+            doc["traceEvents"].extend(
+                trace.serving_chrome_trace(
+                    metas, source=args.jsonl)["traceEvents"])
     n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
     if not n_spans:
         print(f"{args.jsonl}: no timed step records (run with "
-              "--telemetry --metrics to record step_s + wall segments)",
+              "--telemetry --metrics to record step_s + wall segments) "
+              "and no serving tick/request records",
               file=sys.stderr)
         return 2
     out = args.out or (os.path.splitext(args.jsonl)[0] + ".trace.json")
     with open(out, "w") as f:
         json.dump(doc, f)
-    print(f"wrote {out}: {n_spans} spans over {len(steps)} step(s) — "
+    print(f"wrote {out}: {n_spans} spans over {n_laid} {laid_out} — "
           "open in chrome://tracing or https://ui.perfetto.dev")
     return 1 if errs else 0
 

@@ -189,3 +189,49 @@ def test_serving_robustness_schema_v5_names():
             "status": status, "finish": finish,
         })
         assert not errs, (status, errs)
+
+
+def test_serving_observability_schema_v6_names():
+    """Schema-v6 drift guard (serving observability): the `tick` record
+    kind with its full field set, the request lifecycle/attribution
+    fields, and the ICI-vs-DCN gauge must stay documented AND wired —
+    `report_run.py --check` hard-fails any sidecar carrying them
+    otherwise, and the dashboards key on these names."""
+    from tiny_deepspeed_tpu.telemetry import schema
+
+    assert schema.SCHEMA_VERSION >= 6
+    assert "tick" in schema.META_KINDS
+    assert "dcn_wire_bytes" in schema.GAUGES
+    # a representative tick record of each emission class validates
+    for emit in ("event", "sample"):
+        errs = schema.validate_record({
+            "kind": "tick", "ts": 0.0, "tick": 3, "t_s": 1.25,
+            "wall_s": 0.01, "sched_s": 0.001, "prefill_s": 0.004,
+            "decode_s": 0.004, "fetch_s": 0.001, "occupancy": 0.5,
+            "pool_util": 0.25, "queue_depth": 1, "admitted": 1,
+            "evicted": 0, "preempted": 0, "shed": 0, "expired": 0,
+            "quarantined": 0, "restarted": 0, "produced": 2,
+            "emit": emit,
+        })
+        assert not errs, (emit, errs)
+    # a v6 request record (events + latency-component partition)
+    errs = schema.validate_record({
+        "kind": "request", "ts": 0.0, "request_id": 1,
+        "prompt_tokens": 4, "new_tokens": 2, "preemptions": 1,
+        "status": "ok", "finish": "length", "slot": 0,
+        "lat_s": 0.1, "comp_queue_s": 0.02, "comp_prefill_s": 0.01,
+        "comp_decode_s": 0.05, "comp_preempt_s": 0.02,
+        "comp_restart_s": 0.0,
+        "events": [["submitted", 0.0], ["admitted", 0.02, 0],
+                   ["terminal:ok", 0.1, 0]],
+    })
+    assert not errs, errs
+    # the engine still registers the tick-record emission and the
+    # attribution fields it promises
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "serving", "engine.py")) as f:
+        engine_src = f.read()
+    for name in ('kind="tick"', "comp_queue_s", "comp_restart_s",
+                 "serve_restart", "serve_quarantine",
+                 "serve_shed_burst", "serve_recover"):
+        assert name in engine_src, f"{name} gone from serving/engine.py"

@@ -477,7 +477,13 @@ class TestPreemption:
 # ---------------------------------------------------------------------------
 
 class TestElasticResume:
-    @pytest.mark.parametrize("engine_cls", [Zero1, Zero2, Zero3])
+    # tier-1 budget: the Zero3 grow variant is the heaviest test in the
+    # quick tier (~24s) and its unique coverage — Zero3 partition-table
+    # rederivation on a CHANGED mesh — is kept quick by
+    # test_shrink_8_to_4_devices (Zero3, the other direction); Zero1/
+    # Zero2 keep the grow path itself quick
+    @pytest.mark.parametrize("engine_cls", [
+        Zero1, Zero2, pytest.param(Zero3, marks=pytest.mark.slow)])
     def test_grow_4_to_8_devices_loss_parity(self, engine_cls, model,
                                              mesh4, mesh8, tmp_path):
         """Train K steps on 4 devices, checkpoint, restore onto 8,

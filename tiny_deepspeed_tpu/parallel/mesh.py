@@ -220,6 +220,22 @@ class ParallelContext:
         )
 
 
+def granule_map(devices) -> Optional[dict]:
+    """{logical device id: DCN granule index} for a device sequence in
+    MESH-FLAT order (pass `mesh.devices.flatten()`) — the id space a
+    compiled program's replica_groups use, which is what lets
+    `utils/hlo_comm.wire_link_split` classify each collective's wire as
+    intra-slice (ICI) or cross-slice (DCN).  None when the devices form
+    a single granule (one slice / one process — no DCN to cross)."""
+    devices = list(devices)
+    n_gran, attr = _n_granules(devices)
+    if n_gran <= 1:
+        return None
+    gran_ids = sorted({getattr(d, attr) for d in devices})
+    ix = {g: i for i, g in enumerate(gran_ids)}
+    return {i: ix[getattr(d, attr)] for i, d in enumerate(devices)}
+
+
 def mesh_descriptor(mesh: Mesh) -> dict:
     """JSON-safe identity of a mesh's shape: axis names/sizes, device and
     host counts.  Persisted in checkpoint meta sidecars so an elastic

@@ -126,6 +126,13 @@ def run_trace(engine, trace: Sequence[Arrival], *,
     for r in requests:
         status_counts[r.status] = status_counts.get(r.status, 0) + 1
     ok_toks = sum(len(r.tokens) for r in requests if r.status == "ok")
+    # aggregate latency attribution (the per-request partition summed
+    # across the trace): where the trace's total request-seconds went —
+    # the bench-JSON view of what serve_report.py breaks down per tail
+    comp_totals = {
+        k: round(sum(r.lat_components[k] for r in requests), 4)
+        for k in ("queue", "prefill", "decode", "preempt", "restart")
+    }
     return {
         "outputs": {r.id: list(r.tokens) for r in requests},
         "requests": requests,
@@ -141,6 +148,7 @@ def run_trace(engine, trace: Sequence[Arrival], *,
         "ttft": _latency_stats(
             [r.t_first - r.t_arrival for r in requests
              if r.t_first is not None]),
+        "latency_components_s": comp_totals,
         "mean_occupancy": round(float(np.mean(occupancy)), 4)
         if occupancy else 0.0,
         "mean_pool_utilization": round(float(np.mean(pool_util)), 4)

@@ -72,6 +72,35 @@ serve_quarantined / serve_restarts gauges (telemetry/schema.GAUGES),
 admission/eviction/preemption/token counters, TTFT + inter-token latency
 histograms, and a per-request `request` record (terminal `status` field)
 into the JSONL metrics stream at every terminal outcome.
+
+Observability layer (the serving twin of the training step traces):
+
+  * Request-lifecycle spans — every Request accumulates timestamped
+    lifecycle events (submitted -> admitted(slot) -> preempted /
+    restart_requeued / quarantined / expired -> terminal:<status>)
+    recorded inside the scheduler hooks, serialized on its `request`
+    record; `scripts/trace_view.py` lays them out as a Perfetto
+    timeline with one track per decode slot plus a queue track.
+  * Tail-latency attribution — each terminal request's latency is
+    decomposed into queue-wait / prefill / decode-active /
+    preempted-wait / restart-overhead components that PARTITION
+    `lat_s` (sum == terminal latency, pinned by test), so "why was p99
+    400 ms" has a named answer; `scripts/serve_report.py` rolls them up.
+  * Per-tick time series — a `tick` JSONL record (wall split: host
+    scheduling vs prefill vs decode dispatch vs token fetch; occupancy,
+    pool utilization, queue depth; per-tick admission/eviction/
+    preemption/shed counts), emitted when a scheduler event happened OR
+    every `tick_record_every` ticks — long traces stay bounded while
+    every eventful tick is captured.
+  * Serving flight recorder — the last `flight_ticks` tick entries ride
+    a telemetry/flight.py ring (host dicts only, no device sync) and
+    flush as ONE `flight` record when quarantine, a watchdog restart, a
+    shed burst, or `recover()` fires: every postmortem carries its
+    lead-up, not just the event.
+
+All of it is host-side bookkeeping around the SAME compiled programs —
+the decode/prefill HLO is byte-identical with observability on or off
+(the existing serving-off-path pin covers it).
 """
 
 from __future__ import annotations
@@ -135,6 +164,19 @@ class ServeConfig:
     # consecutive poisoned ticks before the watchdog trips.
     health_guard: bool = True
     guard_k_restart: int = 3
+    # per-tick `tick` record sampling cadence: an eventful tick (any
+    # admission/eviction/preemption/shed/expiry/quarantine/restart)
+    # always emits when a logger is attached; a quiet decode tick emits
+    # every this-many ticks (0 = eventful ticks only) — bounded metrics
+    # files on long-running servers
+    tick_record_every: int = 16
+    # serving flight recorder: ring capacity in ticks (0 disables);
+    # flushed as one `flight` record on quarantine / watchdog restart /
+    # shed burst / recover()
+    flight_ticks: int = 64
+    # sheds within one tick window that count as a "shed burst" and
+    # trigger a flight flush (overload postmortems need the lead-up too)
+    shed_burst: int = 3
 
 
 class Request:
@@ -172,6 +214,24 @@ class Request:
         self.active_s = 0.0  # completed active windows (preemptions)
         self.token_lat: List[float] = []  # per-token completion gaps
         self._journaled = False
+        # lifecycle event timeline: (name, t_monotonic[, slot]) tuples,
+        # serialized on the request record — trace_view.py's queue/slot
+        # tracks are built from these
+        self.events: List[tuple] = [("submitted", now)]
+        # tail-latency attribution: the components PARTITION the terminal
+        # latency — at any instant the request is in exactly one bucket
+        # (waiting with a reason, prefilling, or decode-active), and
+        # every transition closes one window with the same timestamp
+        # that opens the next, so the sum telescopes to t_done-t_arrival
+        self.lat_components = {"queue": 0.0, "prefill": 0.0,
+                               "decode": 0.0, "preempt": 0.0,
+                               "restart": 0.0}
+        self._wait_since: Optional[float] = now
+        self._wait_kind = "queue"
+        self.last_slot: Optional[int] = None
+
+    def event(self, name: str, t: float, slot: Optional[int] = None):
+        self.events.append((name, t) if slot is None else (name, t, slot))
 
     @property
     def done(self) -> bool:
@@ -192,12 +252,17 @@ class _Slot:
     current cache length (== the next write position)."""
 
     def __init__(self, req: Request, table: List[int], pos: int,
-                 last_token: int, admitted_at: float):
+                 last_token: int, admitted_at: float,
+                 prefill_s: float = 0.0):
         self.req = req
         self.table = table
         self.pos = pos
         self.last = last_token
         self.admitted_at = admitted_at
+        # this admission's prefill wall — subtracted from the active
+        # window when it closes, so the decode-active component never
+        # double-counts the prefill component
+        self.prefill_s = prefill_s
 
 
 class ServingEngine:
@@ -261,6 +326,20 @@ class ServingEngine:
         self._quarantined = 0
         self._restarts = 0
         self._restarts_since_progress = 0
+        # serving flight recorder (telemetry/flight.py ring reused with
+        # tick entries): record() every tick, flush on fault triggers
+        if config.flight_ticks:
+            from ..telemetry.flight import FlightRecorder
+            self._flight = FlightRecorder(config.flight_ticks)
+        else:
+            self._flight = None
+        self._flight_reason: Optional[str] = None
+        # per-tick wall split + scheduler counts (tick records + flight)
+        self._seg = {"prefill_s": 0.0, "decode_s": 0.0, "fetch_s": 0.0}
+        self._tick_counts = dict.fromkeys(
+            ("admitted", "evicted", "preempted", "expired",
+             "quarantined", "restarted"), 0)
+        self._shed_seen = 0
         # recent decode-step walls: the measured inter-token service
         # time that prices deadline feasibility for queue shedding
         self._gap_hist: Deque[float] = deque(maxlen=128)
@@ -371,6 +450,10 @@ class ServingEngine:
         front-of-line and continue token-exact.  `ServingKilled` (the
         chaos stand-in for process death) always propagates — a real
         kill leaves no engine to restart."""
+        t0 = time.monotonic()
+        tick_i = self._ticks
+        self._seg = {"prefill_s": 0.0, "decode_s": 0.0, "fetch_s": 0.0}
+        self._tick_counts = dict.fromkeys(self._tick_counts, 0)
         try:
             produced = self._tick_body()
         except ServingKilled:
@@ -386,6 +469,7 @@ class ServingEngine:
         if produced:
             self._restarts_since_progress = 0
         self._update_gauges()
+        self._record_tick(tick_i, t0, produced)
         return produced
 
     def drain(self, max_ticks: Optional[int] = None) -> int:
@@ -435,6 +519,11 @@ class ServingEngine:
                           id=e["id"])
             req.tokens = list(e["tokens"])
             req._journaled = self.journal is not None
+            # the wait from recovery to re-admission is restart
+            # overhead, not queue wait: the crash-restart cycle (not
+            # arrival pressure) is what the request is paying for
+            req._wait_kind = "restart"
+            req.event("recovered", req.t_arrival)
             if self._finished(req):
                 # finished before the crash (length OR eos) — only its
                 # end line was lost; close it out, never re-queue
@@ -449,6 +538,12 @@ class ServingEngine:
         self._count("serve_recovered", len(out))
         if self.journal is not None:
             self.journal.commit()  # the closed-out requests' end lines
+        # postmortem marker: a fresh engine has no tick lead-up (it died
+        # with the old process), but the flush stamps the recovery and
+        # how many requests re-queued into the metrics stream
+        if self._flight is not None and self.logger is not None:
+            self._flight.flush(self.logger, "serve_recover",
+                               at_step=self._ticks)
         return out
 
     @property
@@ -540,12 +635,18 @@ class ServingEngine:
                 self.params, self._stacked, self.pool.view,
                 tokens, pos, tables, seeds, nprod, poison,
             )
+            # dispatch returns before the device finishes (async); the
+            # np.asarray token fetch below is the sync — the tick record
+            # splits the two (decode_s vs fetch_s)
+            t_disp = time.monotonic()
             self.pool.view = view
             self.last_logits = logits
             nxt = np.asarray(nxt)
             # same computation, already synchronized by the token fetch
             bad = np.asarray(bad)
             tnow = time.monotonic()
+            self._seg["decode_s"] += t_disp - t_dec
+            self._seg["fetch_s"] += tnow - t_disp
             self._gap_hist.append(tnow - t_dec)
             poisoned = (set(self._guard.observe(bad, [i for i, _ in
                                                       active]))
@@ -656,6 +757,14 @@ class ServingEngine:
             t_adm = time.monotonic()
             if req.t_admitted is None:
                 req.t_admitted = t_adm
+            # the wait window (queue / preempted-wait / restart-overhead,
+            # whichever re-queued it) closes at the same stamp the active
+            # window opens — the attribution components telescope
+            if req._wait_since is not None:
+                req.lat_components[req._wait_kind] += t_adm - req._wait_since
+                req._wait_since = None
+            req.event("admitted", t_adm, slot_i)
+            req.last_slot = slot_i
             bucket = self._bucket(p)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :p] = prompt_now
@@ -665,18 +774,37 @@ class ServingEngine:
             # reached through the slot table, not the prefill scatter
             k = min(len(ids), bucket // bt)
             block_ids[:k] = ids[:k]
-            nxt, view = self._prefill_fn(
-                self.params, self._stacked, padded, p - 1, block_ids,
-                self.pool.view, np.int32(req.seed),
-                np.int32(len(req.tokens)),
-            )
-            self.pool.view = view
-            tok = int(np.asarray(nxt)[0])
+            try:
+                nxt, view = self._prefill_fn(
+                    self.params, self._stacked, padded, p - 1, block_ids,
+                    self.pool.view, np.int32(req.seed),
+                    np.int32(len(req.tokens)),
+                )
+                self.pool.view = view
+                tok = int(np.asarray(nxt)[0])
+            except Exception:
+                # a REAL prefill failure (transient XLA error, wedged
+                # view): put the request back exactly like the chaos
+                # path does, or the watchdog's restart — which only
+                # re-queues OCCUPIED slots — would drop it in a
+                # non-terminal limbo forever.  Re-opening the wait
+                # window at the admission stamp keeps the latency
+                # partition telescoping (the aborted window bills to
+                # the wait bucket it interrupted).
+                self.pool.free_blocks(ids)
+                req.event("admission_aborted", time.monotonic(), slot_i)
+                req._wait_since = t_adm
+                self._queue.appendleft(req)
+                raise
+            pf = time.monotonic() - t_adm
+            self._seg["prefill_s"] += pf
+            req.lat_components["prefill"] += pf
             slot = _Slot(req, table=ids, pos=p, last_token=tok,
-                         admitted_at=t_adm)
+                         admitted_at=t_adm, prefill_s=pf)
             self._slots[slot_i] = slot
             req.state = "active"
             self._count("serve_admissions")
+            self._tick_counts["admitted"] += 1
             self._append_token(req, tok, time.monotonic())
             if self.journal is not None:
                 self.journal.tokens(req.id, [tok])
@@ -706,18 +834,31 @@ class ServingEngine:
                 )
                 self._preempt(victim_i, victim)
 
+    def _close_active(self, req: Request, slot: _Slot,
+                      now: float) -> None:
+        """Close an active window at `now`: the decode-active component
+        is the window minus this admission's prefill wall (already in
+        the prefill component)."""
+        win = now - slot.admitted_at
+        req.active_s += win
+        req.lat_components["decode"] += max(0.0, win - slot.prefill_s)
+
     def _preempt(self, i: int, slot: _Slot) -> None:
         req = slot.req
+        now = time.monotonic()
         self.pool.free_blocks(slot.table)
         self._slots[i] = None
         req.state = "queued"
-        req.active_s += time.monotonic() - slot.admitted_at
+        self._close_active(req, slot, now)
         req.preemptions += 1
+        req._wait_since, req._wait_kind = now, "preempt"
+        req.event("preempted", now, i)
         # front of the queue: it resumes (re-prefilling prompt + tokens
         # so far — an exact continuation under the (seed, position)
         # sampling keys) as soon as blocks free up
         self._queue.appendleft(req)
         self._count("serve_preemptions")
+        self._tick_counts["preempted"] += 1
 
     def _warm_restart(self, reason: str) -> None:
         """Watchdog escalation: rebuild the pool and slot array, keep
@@ -744,14 +885,21 @@ class ServingEngine:
         )
         for i, s in occupied:
             s.req.state = "queued"
-            s.req.active_s += now - s.admitted_at
+            self._close_active(s.req, s, now)
             s.req.preemptions += 1
+            # restart-overhead, not preempted-wait: the engine (not pool
+            # pressure) took the slot away — the attribution dashboard
+            # must bill the watchdog, not the scheduler
+            s.req._wait_since, s.req._wait_kind = now, "restart"
+            s.req.event("restart_requeued", now, i)
             self._queue.appendleft(s.req)
         self._slots = [None] * self.config.max_active
         self._poison_pending.clear()
         self.pool = PagedKVPool(**self._pool_args)
         if self._guard is not None:
             self._guard.reset()
+        self._tick_counts["restarted"] += 1
+        self._arm_flight("serve_restart")
         if self.logger is not None:
             self.logger.log_meta(kind="fault", fault="serve_restart",
                                  at_step=self._ticks, action=reason)
@@ -768,46 +916,70 @@ class ServingEngine:
 
     def _finish(self, i: int, slot: _Slot) -> None:
         req = slot.req
+        now = time.monotonic()
         self.pool.free_blocks(slot.table)
         self._slots[i] = None
         self._evictions += 1
         self._count("serve_evictions")
-        req.active_s += time.monotonic() - slot.admitted_at
-        self._terminal(req, "ok", req.finish_reason or "length")
+        self._tick_counts["evicted"] += 1
+        self._close_active(req, slot, now)
+        self._terminal(req, "ok", req.finish_reason or "length",
+                       now=now, slot=i)
 
     def _expire(self, i: int, slot: _Slot) -> None:
         req = slot.req
+        now = time.monotonic()
         self.pool.free_blocks(slot.table)
         self._slots[i] = None
         self._expired += 1
         self._count("serve_expired")
-        req.active_s += time.monotonic() - slot.admitted_at
-        self._terminal(req, "expired", "deadline")
+        self._tick_counts["expired"] += 1
+        self._close_active(req, slot, now)
+        req.event("expired", now, i)
+        self._terminal(req, "expired", "deadline", now=now, slot=i)
 
     def _quarantine(self, i: int, slot: _Slot) -> None:
         req = slot.req
+        now = time.monotonic()
         self.pool.free_blocks(slot.table)
         self._slots[i] = None
         self._quarantined += 1
         self._count("serve_quarantined")
-        req.active_s += time.monotonic() - slot.admitted_at
-        self._terminal(req, "failed", "nonfinite_logits")
+        self._tick_counts["quarantined"] += 1
+        self._close_active(req, slot, now)
+        req.event("quarantined", now, i)
+        self._arm_flight("serve_quarantine")
+        self._terminal(req, "failed", "nonfinite_logits", now=now, slot=i)
 
     def _shed_req(self, req: Request, reason: str) -> None:
         self._shed += 1
         self._count("serve_shed")
         self._terminal(req, "shed", f"shed:{reason}")
 
-    def _terminal(self, req: Request, status: str, finish: str) -> None:
+    def _terminal(self, req: Request, status: str, finish: str, *,
+                  now: Optional[float] = None,
+                  slot: Optional[int] = None) -> None:
         """The ONE exit for every request outcome: state, journal end
-        line, JSONL `request` record with the terminal `status`."""
+        line, JSONL `request` record with the terminal `status`.
+        `now` is the timestamp the caller already closed its active
+        window with — reusing it keeps the latency-component partition
+        exact (sum(comp_*) == lat_s) instead of leaking the gap between
+        two clock reads into neither bucket."""
         req.state = "done"
         req.status = status
         req.finish_reason = finish
-        req.t_done = time.monotonic()
+        req.t_done = time.monotonic() if now is None else now
+        if req._wait_since is not None:
+            # terminal straight out of a wait (shed in queue, closed-out
+            # recovery): the open wait window is the final component
+            req.lat_components[req._wait_kind] += (
+                req.t_done - req._wait_since)
+            req._wait_since = None
+        req.event(f"terminal:{status}", req.t_done, slot)
         if self.journal is not None and req._journaled:
             self.journal.end(req.id, status, finish)
         if self.logger is not None:
+            comp = req.lat_components
             rec = dict(
                 request_id=req.id,
                 prompt_tokens=len(req.prompt),
@@ -815,7 +987,17 @@ class ServingEngine:
                 preemptions=req.preemptions,
                 status=status,
                 finish=finish,
+                lat_s=round(req.t_done - req.t_arrival, 6),
+                comp_queue_s=round(comp["queue"], 6),
+                comp_prefill_s=round(comp["prefill"], 6),
+                comp_decode_s=round(comp["decode"], 6),
+                comp_preempt_s=round(comp["preempt"], 6),
+                comp_restart_s=round(comp["restart"], 6),
+                events=[[e[0], round(e[1], 6)] + list(e[2:])
+                        for e in req.events],
             )
+            if req.last_slot is not None:
+                rec["slot"] = req.last_slot
             if req.deadline_s is not None:
                 rec["deadline_s"] = req.deadline_s
             if req.t_admitted is not None:
@@ -867,3 +1049,86 @@ class ServingEngine:
         t.gauge("serve_expired", float(self._expired))
         t.gauge("serve_quarantined", float(self._quarantined))
         t.gauge("serve_restarts", float(self._restarts))
+
+    # -- per-tick time series + serving flight recorder ---------------------
+
+    # flush-trigger precedence when several fire in one tick: the record
+    # names the gravest one (a restart subsumes its quarantines)
+    _FLIGHT_PRIORITY = {"serve_shed_burst": 1, "serve_quarantine": 2,
+                        "serve_restart": 3, "serve_recover": 3}
+
+    def _arm_flight(self, reason: str) -> None:
+        cur = self._FLIGHT_PRIORITY.get(self._flight_reason, 0)
+        if self._FLIGHT_PRIORITY[reason] > cur:
+            self._flight_reason = reason
+
+    def _record_tick(self, tick_i: int, t0: float, produced: int) -> None:
+        """End-of-tick bookkeeping: append the tick entry to the flight
+        ring (host dicts, no device sync), emit a `tick` JSONL record
+        when the tick was eventful or the sampling cadence hit, and
+        flush the flight ring if a fault trigger armed it this tick.
+
+        The wall split: prefill/decode/fetch are measured around the two
+        compiled programs (dispatch vs the token-fetch sync); sched_s is
+        the remainder — deadline enforcement, growth, admission
+        bookkeeping, journal commit, gauge updates.  Submit-time sheds
+        happen OUTSIDE ticks and land on the next tick's `shed` count.
+
+        Without a logger none of this can ever be emitted (every flush
+        path needs the sink), so it is skipped wholesale — a production
+        engine with logging off pays nothing per tick, and the flight
+        ring covers ticks from logger attach onward (serve_bench
+        attaches AFTER warmup, so warm ticks stay out of postmortems by
+        construction)."""
+        if self.logger is None:
+            self._flight_reason = None
+            self._shed_seen = self._shed
+            return
+        wall = time.monotonic() - t0
+        seg = self._seg
+        sched = max(0.0, wall - seg["prefill_s"] - seg["decode_s"]
+                    - seg["fetch_s"])
+        shed_delta = self._shed - self._shed_seen
+        self._shed_seen = self._shed
+        if shed_delta >= self.config.shed_burst:
+            self._arm_flight("serve_shed_burst")
+        c = self._tick_counts
+        counts = dict(c, shed=shed_delta, produced=produced)
+        state = dict(
+            occupancy=round(self.n_active / self.config.max_active, 4),
+            pool_util=round(
+                self.pool.blocks_in_use / self.pool.num_usable, 4),
+            queue_depth=len(self._queue),
+        )
+        segments = dict(
+            sched_s=round(sched, 6),
+            prefill_s=round(seg["prefill_s"], 6),
+            decode_s=round(seg["decode_s"], 6),
+            fetch_s=round(seg["fetch_s"], 6),
+        )
+        if self._flight is not None:
+            # the ring reuses FlightRecorder's schema: the tick's state +
+            # counts ride the `health` dict, the wall split `segments`
+            self._flight.record(
+                tick_i, step_s=wall,
+                health={k: float(v) for k, v in
+                        {**state, **counts}.items()},
+                segments=segments,
+            )
+        eventful = any(counts[k] for k in
+                       ("admitted", "evicted", "preempted", "shed",
+                        "expired", "quarantined", "restarted"))
+        every = self.config.tick_record_every
+        sampled = bool(every) and tick_i % every == 0
+        if eventful or sampled:
+            self.logger.log_meta(
+                kind="tick", tick=tick_i,
+                t_s=round(t0, 6), wall_s=round(wall, 6),
+                **segments, **state, **counts,
+                emit="event" if eventful else "sample",
+            )
+        if self._flight_reason is not None:
+            if self._flight is not None:
+                self._flight.flush(self.logger, self._flight_reason,
+                                   at_step=tick_i)
+            self._flight_reason = None

@@ -21,6 +21,12 @@ the health-vector sync that closes the step clock.  Only `flush()` (and
 `snapshot()`) materialize device data, and they run on the anomaly path,
 never per step (tests/test_trace_flight.py pins the no-sync property with
 a poisoned array stand-in).
+
+Second user: the SERVING engine rides the same ring with tick entries
+(`step` = tick index, `health` = occupancy/pool/queue state + scheduler
+counts, `segments` = the tick wall split, no layers), flushed on
+quarantine / watchdog restart / shed burst / recover() — one ring
+implementation, two postmortem surfaces (serving/engine.py::_record_tick).
 """
 
 from __future__ import annotations

@@ -431,12 +431,22 @@ class Telemetry:
         self.gauge("grad_residual_norm", norm)
         return norm
 
-    def capture_compiled(self, state, batch, engine=None):
+    def capture_compiled(self, state, batch, engine=None,
+                         granule_of=None):
         """Measured collective gauges: compile the engine's step for
         (state, batch) and read the REAL collective ledger off the post-
         SPMD HLO (utils/hlo_comm.py), next to the ring-model `comm_report`
         prediction — plus the AOT memory analysis when the backend
-        provides one."""
+        provides one.
+
+        On a hybrid ICI×DCN mesh (multiple slices / processes), the
+        ledger additionally splits wire per LINK: collectives whose
+        replica groups cross a granule boundary are billed to DCN
+        (measured from the compiled replica_groups, not modeled), gauged
+        as `dcn_wire_bytes`.  `granule_of` overrides the device→granule
+        map for CPU-emulated multi-slice tests (default: derived from
+        the engine mesh's slice/process indices,
+        parallel/mesh.granule_map)."""
         from ..utils.hlo_comm import (
             collective_ledger, ledger_summary, overlap_report,
         )
@@ -444,10 +454,20 @@ class Telemetry:
         engine = engine or self._engine
         if engine is None:
             raise ValueError("no engine attached; pass engine=")
+        if granule_of is None:
+            mesh = getattr(engine, "mesh", None)
+            if mesh is not None:
+                from ..parallel.mesh import granule_map
+                granule_of = granule_map(mesh.devices.flatten())
         compiled = engine._step.lower(state, batch).compile()
         compiled_text = compiled.as_text()
         led = collective_ledger(compiled_text)
-        measured = ledger_summary(led)
+        measured = ledger_summary(led, granule_of=granule_of)
+        if granule_of is not None:
+            self.gauge(
+                "dcn_wire_bytes",
+                measured["wire_bytes_by_link"]["dcn_wire_bytes"],
+            )
         model_rep = comm_report(engine)
         # overlap window: how much of the reducing-collective wire is
         # issued inside while bodies (before the backward scan completes)

@@ -41,8 +41,14 @@ _NUM = (int, float)
 #   5: + serving robustness: request records carry the terminal `status`
 #      (ok/shed/expired/failed) + optional deadline_s; fault records may
 #      carry a `slot`; serve_shed / serve_expired / serve_quarantined /
-#      serve_restarts gauges (this PR)
-SCHEMA_VERSION = 5
+#      serve_restarts gauges
+#   6: + serving observability (this PR): `tick` meta kind (per-tick wall
+#      split + scheduler counters), request records grow the lifecycle
+#      `events` timeline and the latency attribution components
+#      (lat_s / comp_*_s), run_meta may carry the `serve` config dict
+#      (what the trace viewer needs to lay out slot tracks), and the
+#      dcn_wire_bytes gauge (per-link ICI-vs-DCN ledger split)
+SCHEMA_VERSION = 6
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -88,6 +94,12 @@ META_KINDS = (
     # serving tier: one record per FINISHED request — queueing, TTFT and
     # decode-rate latency breakdown (serving/engine.py::_finish)
     "request",
+    # serving tier: one record per SAMPLED/EVENTFUL scheduler tick —
+    # wall split (host scheduling vs prefill vs decode dispatch vs token
+    # fetch), occupancy/pool/queue state, and per-tick scheduler counts
+    # (serving/engine.py::tick; event-triggered + sampled emission so a
+    # long-running server's metrics file stays bounded)
+    "tick",
 )
 
 META_FIELDS: Dict[str, tuple] = {
@@ -175,6 +187,55 @@ META_FIELDS: Dict[str, tuple] = {
     # "nonfinite_logits" | "shed:<watermark-or-deadline reason>"
     "finish": str,
     "deadline_s": _NUM,        # the request's SLO, echoed when set
+    # request lifecycle timeline (schema v6): [name, t_s(, slot)] event
+    # triples on the engine's monotonic clock — submitted / admitted /
+    # preempted / restart_requeued / quarantined / expired /
+    # terminal:<status>.  trace_view.py lays them out as queue + slot
+    # tracks; every request record in one file shares the clock.
+    "events": list,
+    # terminal latency (arrival -> terminal) and its attribution
+    # components; the components PARTITION lat_s (sum == lat_s within
+    # float rounding, pinned) so a p99 postmortem can name what the
+    # tail paid: queue-wait, prefill walls, decode-active windows,
+    # preempted-wait (preemption -> re-admission), restart-overhead
+    # (warm-restart/recovery re-queue -> re-admission)
+    "lat_s": _NUM,
+    "comp_queue_s": _NUM,
+    "comp_prefill_s": _NUM,
+    "comp_decode_s": _NUM,
+    "comp_preempt_s": _NUM,
+    "comp_restart_s": _NUM,
+    # tick record (serving scheduler; schema v6).  t_s is the tick-start
+    # stamp on the same monotonic clock as request `events`; wall_s the
+    # full tick wall; sched_s/prefill_s/decode_s/fetch_s partition it
+    # (host scheduling incl. deadline/grow/journal work, prefill program
+    # walls, decode dispatch, token-fetch sync).
+    "tick": int,
+    "t_s": _NUM,
+    "wall_s": _NUM,
+    "sched_s": _NUM,
+    "prefill_s": _NUM,
+    "decode_s": _NUM,
+    "fetch_s": _NUM,
+    "occupancy": _NUM,          # active slots / max_active after the tick
+    "pool_util": _NUM,          # allocated / usable pool blocks
+    "queue_depth": int,
+    # per-tick scheduler counts (deltas over the tick; submit-time sheds
+    # land on the NEXT tick's record)
+    "admitted": int,
+    "evicted": int,
+    "preempted": int,
+    "shed": int,
+    "expired": int,
+    "quarantined": int,
+    "restarted": int,
+    "produced": int,
+    # why this tick record exists: "event" (a count above is nonzero) or
+    # "sample" (the tick_record_every cadence)
+    "emit": str,
+    # run_meta (serving runs): the ServeConfig geometry the trace viewer
+    # needs to lay out slot tracks without rebuilding the engine
+    "serve": dict,
 }
 
 
@@ -325,4 +386,9 @@ GAUGES: Dict[str, str] = {
     "serve_restarts": "engine warm restarts tripped by the decode-"
                       "health watchdog (consecutive poisoned ticks or "
                       "a tick exception), cumulative",
+    "dcn_wire_bytes": "per-device collective wire bytes whose replica "
+                      "groups CROSS a DCN granule boundary (slices / "
+                      "processes) on the hybrid mesh — measured from "
+                      "the compiled HLO's replica_groups, not modeled "
+                      "(utils/hlo_comm.wire_link_split)",
 }

@@ -27,6 +27,19 @@ JSON (chrome://tracing, https://ui.perfetto.dev) using this module; the
 `trace` meta record (schema.py) persists the span template so the viewer
 needs no recompile.  tests/test_trace_flight.py pins that every
 loop-resident span's wire bytes match the ledger.
+
+SERVING runs get their own timeline (`serving_chrome_trace`): the
+request-lifecycle `events` on each `request` record and the per-tick
+`tick` records (serving/engine.py, schema v6) lay out as scheduler-tick
+spans with their measured wall split, a queue track (one span per wait
+window, labeled with WHY the request waited: queue / preempted /
+restart), and one track per decode slot (one span per active window,
+closed with how it ended — finished, preempted, quarantined, expired).
+Quarantines and watchdog restarts are instant markers, so "what led up
+to that restart" is visible at a glance.  All serving stamps share one
+monotonic clock, so the tracks align exactly; only the POSITION of the
+sched/prefill/decode/fetch sub-walls inside a tick is schematic (their
+widths are measured, the true interleave is not recorded).
 """
 
 from __future__ import annotations
@@ -54,6 +67,23 @@ _SPAN_LABELS = {
     ("collective-permute", True): "ring/pipeline permute (in-scan)",
     ("collective-permute", False): "ring/pipeline permute",
 }
+
+
+def _quantile(xs, q: float) -> float:
+    """Linear-interpolated quantile, mirror of
+    utils/profiling._quantile — duplicated HERE (and only here) because
+    this module is the pure-python loader the standalone scripts
+    (trace_view.py, serve_report.py) path-import to avoid the jax tax;
+    scripts must share THIS copy rather than growing their own."""
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    if len(ys) == 1:
+        return ys[0]
+    pos = q * (len(ys) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(ys) - 1)
+    return ys[lo] + (ys[hi] - ys[lo]) * (pos - lo)
 
 
 def collective_span_template(measured: Dict[str, object]) -> List[dict]:
@@ -257,5 +287,219 @@ def chrome_trace(metas: List[dict], steps: List[dict],
             "spans_total_wire_bytes": round(float(sum(
                 s.get("wire_bytes", 0.0) for s in spans
             )), 3),
+        },
+    }
+
+
+# -- serving timeline ---------------------------------------------------------
+
+# serving Chrome-trace track (tid) layout, pid 1 (pid 0 is training)
+_TID_TICK = 0        # scheduler ticks
+_TID_TICK_SEG = 1    # per-tick wall split (sched/prefill/decode/fetch)
+_TID_QUEUE = 2       # request wait windows
+_TID_SLOT0 = 3       # decode slot s -> tid _TID_SLOT0 + s
+
+_WAIT_LABELS = {"queue": "queue wait", "preempt": "preempted wait",
+                "restart": "restart wait"}
+_TICK_SEG_ORDER = ("sched_s", "prefill_s", "decode_s", "fetch_s")
+_TICK_SEG_NAMES = {"sched_s": "host scheduling", "prefill_s": "prefill",
+                   "decode_s": "decode dispatch", "fetch_s": "token fetch"}
+
+
+def has_serving_records(metas: List[dict]) -> bool:
+    """True when the file carries serving-tier records a timeline can be
+    built from (request records with lifecycle events, or tick records)."""
+    return any(
+        m.get("kind") == "tick"
+        or (m.get("kind") == "request" and m.get("events"))
+        for m in metas
+    )
+
+
+def _request_windows(rec: dict) -> List[dict]:
+    """Fold one request record's lifecycle `events` into closed windows:
+    {"track": "queue" | ("slot", i), "label", "t0", "t1", "why"}.  Every
+    wait window closes at the admission (or terminal) that ends it; every
+    active window closes at the preemption / quarantine / expiry /
+    terminal that vacates the slot — the same timestamps the engine's
+    latency-component partition uses, so track walls and `comp_*_s`
+    agree by construction."""
+    rid = rec.get("request_id", "?")
+    out: List[dict] = []
+    wait_t = wait_kind = None
+    active = None  # (slot, t_admitted)
+
+    def close_wait(t):
+        nonlocal wait_t
+        if wait_t is not None and t > wait_t:
+            out.append({"track": "queue",
+                        "label": f"req {rid}", "t0": wait_t, "t1": t,
+                        "why": _WAIT_LABELS.get(wait_kind, wait_kind)})
+        wait_t = None
+
+    def close_active(t, why):
+        nonlocal active
+        if active is not None:
+            slot, t_adm = active
+            out.append({"track": ("slot", slot),
+                        "label": f"req {rid}", "t0": t_adm, "t1": t,
+                        "why": why})
+        active = None
+
+    for e in rec.get("events") or []:
+        name, t = e[0], float(e[1])
+        slot = int(e[2]) if len(e) > 2 and e[2] is not None else None
+        if name in ("submitted", "recovered"):
+            wait_t = t
+            wait_kind = "queue" if name == "submitted" else "restart"
+        elif name == "admitted":
+            close_wait(t)
+            active = (slot if slot is not None else 0, t)
+        elif name in ("preempted", "restart_requeued"):
+            close_active(t, "preempted" if name == "preempted"
+                         else "warm restart")
+            wait_t = t
+            wait_kind = ("preempt" if name == "preempted" else "restart")
+        elif name in ("quarantined", "expired"):
+            close_active(t, name)
+        elif name == "admission_aborted":
+            # a real prefill failure bounced the admission: the aborted
+            # sliver closes here and the request re-queues (the engine
+            # re-opened its wait window at the admission stamp)
+            close_active(t, "aborted")
+            wait_t = t
+        elif name.startswith("terminal:"):
+            close_active(t, name.split(":", 1)[1])
+            close_wait(t)
+    return out
+
+
+def serving_chrome_trace(metas: List[dict],
+                         source: str = "") -> Dict[str, object]:
+    """Chrome-trace JSON for a serving run's records: scheduler-tick
+    spans + their measured wall split, one queue track, one track per
+    decode slot, quarantine/restart instant markers.  Timestamps are
+    microseconds from the earliest serving stamp (every serving record
+    shares the engine's monotonic clock)."""
+    ticks = [m for m in metas if m.get("kind") == "tick"
+             and isinstance(m.get("t_s"), (int, float))]
+    reqs = [m for m in metas if m.get("kind") == "request"]
+    windows = [w for r in reqs for w in _request_windows(r)]
+    run = _find(metas, "run_meta") or {}
+    serve = run.get("serve") or {}
+    n_slots = serve.get("max_active")
+    if not isinstance(n_slots, int) or n_slots < 1:
+        n_slots = 1 + max(
+            (w["track"][1] for w in windows
+             if isinstance(w["track"], tuple)), default=-1)
+
+    stamps = ([t["t_s"] for t in ticks]
+              + [w["t0"] for w in windows])
+    t0 = min(stamps, default=0.0)
+
+    def us(seconds: float) -> float:
+        return round(seconds * 1e6, 3)
+
+    events: List[dict] = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": f"serving run {source}".strip()}},
+        {"ph": "M", "pid": 1, "tid": _TID_TICK, "name": "thread_name",
+         "args": {"name": "scheduler ticks"}},
+        {"ph": "M", "pid": 1, "tid": _TID_TICK_SEG, "name": "thread_name",
+         "args": {"name": "tick wall split"}},
+        {"ph": "M", "pid": 1, "tid": _TID_QUEUE, "name": "thread_name",
+         "args": {"name": "queue"}},
+    ]
+    for s in range(n_slots):
+        events.append({"ph": "M", "pid": 1, "tid": _TID_SLOT0 + s,
+                       "name": "thread_name",
+                       "args": {"name": f"slot {s}"}})
+
+    for rec in ticks:
+        start = rec["t_s"] - t0
+        wall = float(rec.get("wall_s") or 0.0)
+        events.append({
+            "ph": "X", "pid": 1, "tid": _TID_TICK,
+            "name": f"tick {rec.get('tick', '?')}",
+            "ts": us(start), "dur": us(wall),
+            "args": _json_safe({
+                k: rec[k] for k in
+                ("occupancy", "pool_util", "queue_depth", "admitted",
+                 "evicted", "preempted", "shed", "expired",
+                 "quarantined", "restarted", "produced", "emit")
+                if k in rec
+            }),
+        })
+        # measured sub-walls laid out sequentially (position schematic:
+        # the true interleave of scheduling/prefill/decode isn't
+        # recorded; the WIDTHS are the measured splits)
+        cursor = start
+        for key in _TICK_SEG_ORDER:
+            seg = rec.get(key)
+            if not isinstance(seg, (int, float)) or seg <= 0.0:
+                continue
+            events.append({
+                "ph": "X", "pid": 1, "tid": _TID_TICK_SEG,
+                "name": _TICK_SEG_NAMES[key],
+                "ts": us(cursor), "dur": us(seg),
+                "args": {"seconds": seg, "schematic_position": True},
+            })
+            cursor += seg
+        if rec.get("restarted"):
+            events.append({
+                "ph": "i", "pid": 1, "tid": _TID_TICK, "s": "p",
+                "name": "watchdog warm restart", "ts": us(start + wall),
+            })
+
+    for w in windows:
+        tid = (_TID_QUEUE if w["track"] == "queue"
+               else _TID_SLOT0 + w["track"][1])
+        events.append({
+            "ph": "X", "pid": 1, "tid": tid, "name": w["label"],
+            "ts": us(w["t0"] - t0), "dur": us(w["t1"] - w["t0"]),
+            "args": {"window": w["why"]},
+        })
+        if w["why"] == "quarantined":
+            events.append({
+                "ph": "i", "pid": 1, "tid": tid, "s": "t",
+                "name": f"quarantine ({w['label']})",
+                "ts": us(w["t1"] - t0),
+            })
+
+    # flight markers anchor by FILE ORDER, not just tick index: one
+    # sidecar can carry two engine lifetimes (pre-kill engine, then the
+    # recovered one) whose tick counters both start at 0 — the right
+    # anchor is the last matching tick WRITTEN BEFORE the flush (the
+    # engine emits the tick record ahead of its flush), falling back to
+    # the first matching one after it (recover() flushes before the
+    # fresh engine's tick 0 exists)
+    for fi, fl in enumerate(metas):
+        if fl.get("kind") != "flight" or not str(
+                fl.get("reason", "")).startswith("serve_"):
+            continue
+        at = fl.get("at_step")
+        matches = [(mi, m) for mi, m in enumerate(metas)
+                   if m.get("kind") == "tick" and m.get("tick") == at
+                   and isinstance(m.get("t_s"), (int, float))]
+        before = [m for mi, m in matches if mi < fi]
+        after = [m for mi, m in matches if mi > fi]
+        anchor = before[-1] if before else (after[0] if after else None)
+        if anchor is not None:
+            events.append({
+                "ph": "i", "pid": 1, "tid": _TID_TICK, "s": "p",
+                "name": f"flight flush ({fl['reason']})",
+                "ts": us(anchor["t_s"] - t0
+                         + float(anchor.get("wall_s") or 0.0)),
+            })
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": source,
+            "serving": True,
+            "slots": n_slots,
+            "ticks": len(ticks),
+            "requests": len(reqs),
         },
     }

@@ -216,6 +216,50 @@ def _group_size(line: str):
     return None
 
 
+# full membership parse (the ICI-vs-DCN link split needs WHICH devices,
+# not just how many): the explicit list form, and the iota v2 form
+# "[shape]<=[dims]" with an optional T(perm) transpose — the general
+# encoding XLA prints (arange(prod(dims)).reshape(dims).transpose(perm)
+# .reshape(shape); rows are the groups)
+_GROUPS_FULL_LIST_RE = re.compile(
+    r"replica_groups=\{(\{[\d,]+\}(?:,\{[\d,]+\})*)\}"
+)
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+(?:,\d+)*)\]<=\[(\d+(?:,\d+)*)\]"
+    r"(?:T\((\d+(?:,\d+)*)\))?"
+)
+
+
+def _group_members(line: str):
+    """Tuple of per-group participant-id tuples for a collective's
+    replica_groups, or None when the encoding is unrecognized.  Ids are
+    the program's logical device ids — positions in the mesh's flattened
+    device order for the SPMD programs this repo compiles."""
+    m = _GROUPS_FULL_LIST_RE.search(line)
+    if m:
+        return tuple(
+            tuple(int(x) for x in grp.split(","))
+            for grp in re.findall(r"\{([\d,]+)\}", m.group(1))
+        )
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        import numpy as np
+
+        shape = [int(x) for x in m.group(1).split(",")]
+        dims = [int(x) for x in m.group(2).split(",")]
+        n = 1
+        for d in dims:
+            n *= d
+        ids = np.arange(n).reshape(dims)
+        if m.group(3):
+            ids = ids.transpose([int(x) for x in m.group(3).split(",")])
+        if len(shape) == 1:
+            shape = [1] + shape  # "[N]<=[N]": one group of everybody
+        ids = ids.reshape(shape)
+        return tuple(tuple(int(x) for x in row) for row in ids)
+    return None
+
+
 def collective_ledger(compiled_text: str) -> Dict[str, object]:
     """Per-device, per-step collective totals from post-SPMD HLO text.
 
@@ -241,6 +285,12 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
         for ln in comps.get(comp_name, []):
             if "replica_groups=" in ln:
                 return _group_size(ln)
+        return None
+
+    def _comp_group_members(comp_name: str):
+        for ln in comps.get(comp_name, []):
+            if "replica_groups=" in ln:
+                return _group_members(ln)
         return None
 
     # fusion payload computation -> the computation that calls it (see the
@@ -279,7 +329,8 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                     n = 1
                 by_dt = _shape_bytes_by_dtype(seg)
                 local[name].append(
-                    ("reduce-scatter", sum(by_dt.values()), n, by_dt)
+                    ("reduce-scatter", sum(by_dt.values()), n, by_dt,
+                     _comp_group_members(fm.group(1)))
                 )
                 continue  # deliberately NOT walked into (see _FUSION_CALL_RE)
             for op in _COLLECTIVES:
@@ -312,7 +363,8 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                     unresolved_groups.append(ln.strip()[:160])
                     n = 1
                 by_dt = _shape_bytes_by_dtype(seg)
-                local[name].append((op, sum(by_dt.values()), n, by_dt))
+                local[name].append((op, sum(by_dt.values()), n, by_dt,
+                                    _group_members(ln)))
                 break
             wm = _WHILE_RE.search(ln)
             if wm:
@@ -350,12 +402,15 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
     count: Dict[str, float] = {}
     wire_in_loops: Dict[str, float] = {}
     count_in_loops: Dict[str, float] = {}
+    # wire keyed by the collective's PARTICIPANT groups (None = encoding
+    # unrecognized) — what wire_link_split classifies as ICI vs DCN
+    wire_by_groups: Dict[object, float] = {}
 
     def walk(comp: str, mult: float, seen: tuple,
              in_loop: bool = False) -> None:
         if comp in seen:  # cycles don't exist in HLO; belt and braces
             return
-        for op, b, n, by_dt in local.get(comp, []):
+        for op, b, n, by_dt, members in local.get(comp, []):
             payload[op] = payload.get(op, 0.0) + mult * b
             count[op] = count.get(op, 0.0) + mult
             if op == "all-reduce":
@@ -369,6 +424,8 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
             else:  # all-to-all
                 w = b * (n - 1) / n if n > 1 else 0.0
             wire[op] = wire.get(op, 0.0) + mult * w
+            wire_by_groups[members] = (
+                wire_by_groups.get(members, 0.0) + mult * w)
             if in_loop:
                 # a collective INSIDE a while body runs before the loop
                 # finishes — for the backward scan, before the backward
@@ -403,6 +460,7 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
         "count": count,
         "wire_bytes_in_loops": wire_in_loops,
         "count_in_loops": count_in_loops,
+        "wire_bytes_by_groups": wire_by_groups,
         "total_wire_bytes": sum(wire.values()),
         "unresolved_loops": unresolved,
         "unresolved_groups": unresolved_groups,
@@ -551,13 +609,59 @@ def overlap_report(compiled_text: str,
     }
 
 
-def ledger_summary(led: Dict[str, object]) -> Dict[str, object]:
+def wire_link_split(led: Dict[str, object],
+                    granule_of: Dict[int, int]) -> Dict[str, float]:
+    """ICI-vs-DCN wire split of a compiled step's collectives, MEASURED
+    from their replica_groups — the per-axis accounting the ZeRO++
+    agenda needs (cross-slice bytes as a pinned number, not a model;
+    arXiv:2306.10209 motivates why the split matters: DCN is an order
+    of magnitude slower than ICI, so a byte's cost depends on which
+    link carries it).
+
+    `granule_of` maps a logical device id (position in the mesh's
+    flattened device order — `parallel/mesh.granule_map`) to its DCN
+    granule (slice / process).  A collective whose participant group
+    stays inside ONE granule rides ICI; a group spanning granules must
+    cross DCN, and ALL of its wire is billed to DCN (the conservative
+    reading: the ring topology inside a crossing group is XLA's choice,
+    not visible in the HLO).  Collectives whose replica_groups encoding
+    was unrecognized are reported, not guessed."""
+    ici = dcn = unresolved = 0.0
+    dcn_groups = []
+    for members, w in led.get("wire_bytes_by_groups", {}).items():
+        if members is None:
+            unresolved += w
+            continue
+        crossing = any(
+            len({granule_of.get(d) for d in grp}) > 1
+            for grp in members
+        )
+        if crossing:
+            dcn += w
+            dcn_groups.append(members)
+        else:
+            ici += w
+    total = ici + dcn
+    return {
+        "ici_wire_bytes": float(ici),
+        "dcn_wire_bytes": float(dcn),
+        "dcn_frac": float(dcn / total) if total else 0.0,
+        "unresolved_wire_bytes": float(unresolved),
+        "dcn_crossing_collectives": len(dcn_groups),
+    }
+
+
+def ledger_summary(led: Dict[str, object],
+                   granule_of: Optional[Dict[int, int]] = None
+                   ) -> Dict[str, object]:
     """JSON-safe compact form of a `collective_ledger` result for the
     telemetry run_meta record: per-op wire/payload bytes and counts plus
     unresolved-attribution COUNTS (the full flagged lines stay with the
     ledger; a metrics file only needs to know whether attribution was
-    complete)."""
-    return {
+    complete).  With `granule_of` (a hybrid ICI×DCN mesh —
+    `parallel/mesh.granule_map`), adds the measured per-link wire split
+    under `wire_bytes_by_link`."""
+    out = {
         "wire_bytes": {k: float(v) for k, v in led["wire_bytes"].items()},
         "payload_bytes": {
             k: float(v) for k, v in led["payload_bytes"].items()
@@ -583,6 +687,9 @@ def ledger_summary(led: Dict[str, object]) -> Dict[str, object]:
         "unresolved_loops": len(led["unresolved_loops"]),
         "unresolved_groups": len(led["unresolved_groups"]),
     }
+    if granule_of is not None:
+        out["wire_bytes_by_link"] = wire_link_split(led, granule_of)
+    return out
 
 
 def hlo_comm_report(engine, state, batch) -> Dict[str, object]:
