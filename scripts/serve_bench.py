@@ -90,6 +90,15 @@ def main(argv=None) -> int:
                    help="tick-delay fault duration")
     p.add_argument("--journal", default=None, metavar="PATH",
                    help="append the crash-recovery request journal here")
+    p.add_argument("--spec-draft", default=None, metavar="DRAFTER",
+                   help="speculative decoding drafter: 'ngram' "
+                        "(model-free prompt lookup), 'model:self', or "
+                        "'model:<preset>' (serving/drafter.py); greedy "
+                        "output stays token-exact, committed tokens/s "
+                        "is the number to compare")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft span width: up to this many tokens "
+                        "proposed+verified per slot per tick")
     p.add_argument("--serial", action="store_true",
                    help="also run the one-at-a-time generate() baseline "
                         "on the same trace and report the ratio")
@@ -143,6 +152,7 @@ def main(argv=None) -> int:
         temperature=args.temperature, top_k=args.top_k,
         seed=args.seed, max_seq_tokens=max_seq,
         max_queue=args.max_queue, shed_pool_util=args.shed_pool_util,
+        spec_draft=args.spec_draft, spec_k=args.spec_k,
     )
     realtime = not args.closed_loop and args.rate is not None
 
@@ -165,6 +175,8 @@ def main(argv=None) -> int:
                         num_blocks=args.num_blocks, block_tokens=bt,
                         max_seq_tokens=max_seq,
                         quant=args.kv_quant or "off",
+                        spec_draft=args.spec_draft or "off",
+                        spec_k=args.spec_k,
                     ))
         return lg
 
@@ -224,6 +236,9 @@ def main(argv=None) -> int:
         "preemptions": res["preemptions"],
         "pool": eng.pool.kv_bytes(),
     }
+    if "spec" in res:
+        summary["spec"] = dict(res["spec"], drafter=args.spec_draft,
+                               k=args.spec_k)
 
     if args.chaos:
         # goodput under faults, A/B on the SAME trace: the clean pass
@@ -303,6 +318,11 @@ def main(argv=None) -> int:
     print(f"outcomes: ok {sc['ok']} / shed {sc['shed']} / "
           f"expired {sc['expired']} / failed {sc['failed']} "
           f"(goodput {res['ok_tokens_per_s']} tok/s)")
+    if "spec" in summary:
+        sp = summary["spec"]
+        print(f"speculation [{sp['drafter']} k={sp['k']}]: "
+              f"accept rate {sp['accept_rate']} "
+              f"({sp['accepted']}/{sp['proposed']} drafts)")
     if args.chaos:
         ch = summary["chaos"]
         if ch.get("journal_killed"):

@@ -187,6 +187,36 @@ def render_serve_report(metas: List[dict], source: str = "") -> str:
             f"({top[0]:.0%} of tail latency).\n"
         )
 
+    # -- speculation --------------------------------------------------------
+    spec_reqs = [r for r in reqs
+                 if isinstance(r.get("spec_proposed"), int)]
+    if spec_reqs:
+        proposed = sum(r["spec_proposed"] for r in spec_reqs)
+        accepted = sum(r.get("spec_accepted", 0) for r in spec_reqs)
+        out.append("## Speculation\n")
+        out.append(f"- drafts proposed {proposed}, accepted {accepted} "
+                   f"(accept rate "
+                   f"{accepted / max(1, proposed):.2f}) — the committed "
+                   "sequences are target-exact regardless; the rate "
+                   "decides whether the draft+verify walls pay")
+        rates = sorted(
+            r.get("spec_accepted", 0) / max(1, r["spec_proposed"])
+            for r in spec_reqs if r["spec_proposed"])
+        if rates:
+            out.append(
+                f"- per-request accept rate: min {rates[0]:.2f}, "
+                f"median {_quantile(rates, 0.5):.2f}, "
+                f"max {rates[-1]:.2f}")
+        draft = sum(float(t.get("draft_s", 0.0)) for t in ticks)
+        verify = sum(float(t.get("decode_s", 0.0))
+                     + float(t.get("fetch_s", 0.0)) for t in ticks)
+        if draft or verify:
+            out.append(f"- draft vs verify wall: {draft:.3f} s vs "
+                       f"{verify:.3f} s "
+                       f"({draft / max(draft + verify, 1e-9):.0%} of "
+                       "decode time spent drafting)")
+        out.append("")
+
     # -- SLO headroom -------------------------------------------------------
     slo = [(float(r["deadline_s"]) - float(r["lat_s"])) for r in served
            if isinstance(r.get("deadline_s"), (int, float))]
@@ -266,6 +296,10 @@ def render_serve_report(metas: List[dict], source: str = "") -> str:
                 ("prefill_s", "prefill"),
                 ("decode_s", "decode dispatch"),
                 ("fetch_s", "token fetch")]
+        if any(isinstance(t.get("draft_s"), (int, float))
+               for t in ticks):
+            # spec runs split decode into draft vs verify walls
+            segs.insert(1, ("draft_s", "draft propose"))
         tot = sum(sum(float(t.get(k, 0.0)) for t in ticks)
                   for k, _ in segs) or 1e-9
         out.append("\n| tick segment | total | share |")

@@ -781,10 +781,18 @@ before = eng._step.lower(state, batch).as_text()
 from tiny_deepspeed_tpu.serving import ServeConfig, ServingEngine
 from tiny_deepspeed_tpu.serving import guard as _guard   # noqa: F401
 from tiny_deepspeed_tpu.serving import journal as _jrn   # noqa: F401
+from tiny_deepspeed_tpu.serving import spec as _spec     # noqa: F401
+from tiny_deepspeed_tpu.serving import drafter as _drf   # noqa: F401
 model = GPT2Model(cfg)
 se = ServingEngine(model, model.init(jax.random.PRNGKey(0)),
                    ServeConfig(max_active=2, num_blocks=4,
                                block_tokens=8, health_guard=True))
+# a SPECULATIVE engine constructed too: the spec machinery (drafter +
+# verify program) must not perturb the training step's HLO either
+se2 = ServingEngine(model, model.init(jax.random.PRNGKey(0)),
+                    ServeConfig(max_active=2, num_blocks=4,
+                                block_tokens=8, spec_draft="ngram",
+                                spec_k=2))
 eng2 = SingleDevice(GPT2Model(cfg), SGD(lr=0.1))
 state2 = eng2.init(jax.random.PRNGKey(0))
 after = eng2._step.lower(state2, batch).as_text()

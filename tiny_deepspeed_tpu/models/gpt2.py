@@ -633,6 +633,124 @@ class GPT2Model:
             unroll=self.config.scan_unroll)
         return x, view
 
+    # -- speculative verification (serving/spec.py) ------------------------
+    #
+    # One target pass scores a whole DRAFT SPAN per slot — the committed
+    # head token plus up to K drafter proposals at positions
+    # pos..pos+K — instead of one token per tick.  The span's K/V never
+    # touch the pool here: the committed prefix is read through the
+    # block tables (positions < pos), the span attends to itself through
+    # a windowed causal mask, and serving/pool.paged_append_span commits
+    # only the ACCEPTED prefix afterwards (rejected-draft K/V route to
+    # scratch).  The attention math is `_decode_attention` extended to
+    # K1 query positions; everything else reuses the paged machinery.
+
+    def _embed_decode_span(self, params, toks, positions):
+        """(S, K1) tokens at (S, K1) absolute positions -> (S, K1, D)
+        compute-dtype activations (the span analogue of
+        `_embed_decode`'s vector-position path)."""
+        x = self.embed_tokens(params, toks)
+        wp = params["wpe"][positions]  # (S, K1, D), OOB rows clamped
+        return x + wp.astype(x.dtype)
+
+    def _span_attention(self, q, ck, cv, sk, sv, pos0):
+        """Windowed-causal attention over committed cache + draft span.
+        q: (S, Hq, K1, Dh) span queries; ck/cv: (S, KVH, T, Dh) pool
+        panels holding the COMMITTED prefix (positions < pos0 valid);
+        sk/sv: (S, KVH, K1, Dh) the span's own K/V (offset j at absolute
+        position pos0+j).  Query j sees pool positions < pos0[s] plus
+        span offsets <= j — exactly the causal mask of positions
+        <= pos0+j, split across the two sources.  GQA groups query heads
+        per KV head like `_decode_attention`; scores/softmax in f32."""
+        s, hq, k1, dh = q.shape
+        hkv = ck.shape[1]
+        t = ck.shape[2]
+        scale = 1.0 / math.sqrt(dh)
+        out_dtype = q.dtype
+        q = q.astype(ck.dtype)
+        kf = jnp.concatenate([ck, sk.astype(ck.dtype)], axis=2)
+        vf = jnp.concatenate([cv, sv.astype(cv.dtype)], axis=2)
+        pool_mask = jnp.broadcast_to(
+            (jnp.arange(t)[None, None, :] < pos0[:, None, None])[:, None],
+            (s, 1, k1, t),
+        )
+        span_mask = jnp.broadcast_to(
+            jnp.tril(jnp.ones((k1, k1), bool))[None, None], (s, 1, k1, k1)
+        )
+        mask = jnp.concatenate([pool_mask, span_mask], axis=-1)
+        if hq != hkv:
+            g = hq // hkv
+            att = jnp.einsum(
+                "skgqd,sktd->skgqt", q.reshape(s, hkv, g, k1, dh), kf,
+                preferred_element_type=jnp.float32) * scale
+            att = jnp.where(mask[:, :, None], att, -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1)
+            y = jnp.einsum("skgqt,sktd->skgqd", att.astype(vf.dtype), vf,
+                           preferred_element_type=jnp.float32)
+            y = y.reshape(s, hq, k1, dh)
+        else:
+            att = jnp.einsum("shqd,shtd->shqt", q, kf,
+                             preferred_element_type=jnp.float32) * scale
+            att = jnp.where(mask, att, -jnp.inf)
+            att = jax.nn.softmax(att, axis=-1)
+            y = jnp.einsum("shqt,shtd->shqd", att.astype(vf.dtype), vf,
+                           preferred_element_type=jnp.float32)
+        return y.astype(out_dtype)
+
+    def _paged_verify_attn(self, x, bp, view, l, page):
+        """Attention half of one verify step: x (S, K1, D); the pool
+        view is READ-ONLY (committed panel via the block tables) — the
+        span's K/V return as this layer's scan ys for the post-
+        acceptance commit."""
+        c = self.config
+        s, k1, _ = x.shape
+        h = layernorm(x, bp["ln_1.w"], bp["ln_1.b"])
+        qkv = linear(h, self._bw(bp, "attn.qkv.w"), bp.get("attn.qkv.b"))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):
+            return z.reshape(s, k1, c.n_head, c.head_dim).swapaxes(1, 2)
+
+        from ..serving.pool import paged_panel
+        kh, vh = heads(k), heads(v)
+        ck, cv = paged_panel(view, l, page, c.compute_dtype)
+        y = self._span_attention(heads(q), ck, cv, kh, vh, page.pos)
+        y = y.swapaxes(1, 2).reshape(s, k1, c.n_embd)
+        y = linear(y, self._bw(bp, "attn.proj.w"), bp.get("attn.proj.b"))
+        return x + y, (kh, vh)
+
+    def _paged_verify_block(self, x, bp, view, l, page):
+        x, kv = self._paged_verify_attn(x, bp, view, l, page)
+        return self._mlp_decode(x, bp), kv
+
+    def paged_verify(self, stacked, x, view, page):
+        """Layer loop for one speculative verify: x (S, K1, D) span
+        activations.  The view is never written (it rides the closure,
+        not the carry); each layer's span K/V stack as scan ys —
+        (L, S, KVH, K1, Dh) per side — for `paged_append_span` to commit
+        the accepted prefix."""
+        n_layer = jax.tree.leaves(stacked)[0].shape[0]
+
+        def body(x, l):
+            bp = jax.tree.map(
+                lambda t: jax.lax.dynamic_index_in_dim(
+                    t, l, 0, keepdims=False), stacked)
+            x, kv = self._paged_verify_block(x, bp, view, l, page)
+            return x, kv
+
+        x, (sks, svs) = jax.lax.scan(
+            body, x, jnp.arange(n_layer),
+            unroll=self.config.scan_unroll)
+        return x, sks, svs
+
+    def head_span(self, params, x):
+        """Final norm + lm_head at EVERY position of x (S, K1, D) ->
+        (S, K1, V) f32 — the verify step needs the target distribution
+        at all K1 span positions, not just the last (the `position`
+        slice `head` takes on the single-token path)."""
+        x = self.final_norm(params, x)
+        return linear(x, self._lm_head_w(params), None).astype(jnp.float32)
+
     def paged_prefill(self, params, idx, last_pos, block_ids, view,
                       block_tokens: int, stacked=None):
         """Prompt pass for ONE request into the paged pool: idx (1, P)

@@ -96,15 +96,26 @@ def rope(x, positions, theta: float):
 def rope_at(x, positions, theta: float):
     """RoPE for a one-token-per-row batch: x (B, H, 1, Dh), positions (B,)
     — each row rotated at its OWN position (the serving tier's paged
-    decode, where concurrent requests sit at different lengths).  Same
-    elementwise math as `rope`, so a row at position p gets bit-identical
-    treatment on both paths."""
+    decode, where concurrent requests sit at different lengths).  The
+    one-position special case of `rope_span` — delegating keeps the
+    rotation math in a single body, so a row at position p gets
+    bit-identical treatment on every path."""
+    return rope_span(x, positions[:, None], theta)
+
+
+def rope_span(x, positions, theta: float):
+    """RoPE for a draft-span batch: x (S, H, K1, Dh), positions (S, K1)
+    — row s's span position j rotated at positions[s, j] (the serving
+    tier's speculative verify, where each slot's span starts at its own
+    committed head).  Same elementwise math as `rope`/`rope_at`, so a
+    token at absolute position p gets bit-identical treatment on every
+    path."""
     dh = x.shape[-1]
     half = dh // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (B, half)
-    cos = jnp.cos(ang)[:, None, None, :]
-    sin = jnp.sin(ang)[:, None, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freqs  # (S, K1, half)
+    cos = jnp.cos(ang)[:, None]  # (S, 1, K1, half)
+    sin = jnp.sin(ang)[:, None]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     out = jnp.concatenate(
@@ -298,10 +309,39 @@ class LlamaModel(GPT2Model):
         y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
         return x + linear(y, self._bw(bp, "attn.o.w"), None), view
 
+    def _paged_verify_attn(self, x, bp, view, l, page):
+        """Speculative-verify attention (GPT2Model contract): separate
+        q/k/v projections, RoPE at each span token's absolute position,
+        grouped attention over committed panel + windowed span."""
+        c = self.config
+        s, k1, _ = x.shape
+        hd = c.head_dim
+        h = rmsnorm(x, bp["ln_1.w"])
+        q = linear(h, self._bw(bp, "attn.q.w"), None)
+        k = linear(h, self._bw(bp, "attn.k.w"), None)
+        v = linear(h, self._bw(bp, "attn.v.w"), None)
+        q = q.reshape(s, k1, c.n_head, hd).swapaxes(1, 2)
+        k = k.reshape(s, k1, c.kv_heads, hd).swapaxes(1, 2)
+        v = v.reshape(s, k1, c.kv_heads, hd).swapaxes(1, 2)
+        positions = page.pos[:, None] + jnp.arange(k1)[None, :]
+        q = rope_span(q, positions, c.rope_theta)
+        k = rope_span(k, positions, c.rope_theta)
+        from ..serving.pool import paged_panel
+        ck, cv = paged_panel(view, l, page, c.compute_dtype)
+        y = self._span_attention(q, ck, cv, k, v, page.pos)
+        y = y.swapaxes(1, 2).reshape(s, k1, c.n_embd)
+        return x + linear(y, self._bw(bp, "attn.o.w"), None), (k, v)
+
     def _embed_decode(self, params, tok, pos):
         """No wpe table — position enters via RoPE inside each block."""
         del pos
         return self.embed_tokens(params, tok[:, None])
+
+    def _embed_decode_span(self, params, toks, positions):
+        """No wpe table — span positions enter via `rope_span` inside
+        each verify block."""
+        del positions
+        return self.embed_tokens(params, toks)
 
     def final_norm(self, params, x):
         """RMSNorm pre-head (GPT2Model.head's one overridable hook — the

@@ -27,9 +27,7 @@ def sample_logits(logit, key, temperature: float,
     categorical over logits/temperature, restricted to the top_k logits
     when top_k is given.  `temperature`/`top_k` are static (compiled
     into the program) — both call sites key their jit caches on them."""
-    if top_k is not None:
-        kth = jax.lax.top_k(logit, top_k)[0][:, -1:]
-        logit = jnp.where(logit < kth, -jnp.inf, logit)
+    logit = _top_k_filter(logit, top_k)
     if temperature == 0.0:
         return jnp.argmax(logit, axis=-1).astype(jnp.int32)
     return jax.random.categorical(
@@ -80,3 +78,140 @@ def sample_logits_per_slot(logit, base_key, seeds, positions,
                                 temperature, top_k)[0]
 
     return jax.vmap(one)(logit, seeds, positions)
+
+
+def _top_k_filter(logit, top_k: Optional[int]):
+    """-inf everything below each row's k-th logit — THE support
+    restriction (`sample_logits` delegates here), on arbitrary leading
+    axes."""
+    if top_k is None:
+        return logit
+    kth = jax.lax.top_k(logit, top_k)[0][..., -1:]
+    return jnp.where(logit < kth, -jnp.inf, logit)
+
+
+def _accept_or_residual(p_row, prop, key):
+    """ONE position's committed token under the speculative
+    accept-or-residual rule: given the target's (V,) probability row
+    `p_row`, the drafter's point-mass proposal `prop`, and the
+    position's key, commit `prop` iff u < p(prop) (u ~ U[0,1) from
+    `key`), else draw from the renormalized residual (p with `prop`
+    zeroed) under fold_in(key, 1).  The marginal is exactly `p_row`
+    either way.  This is the SINGLE implementation both commit sites
+    ride — `spec_prefill_commit` and `spec_accept_per_slot`'s final
+    token — because the determinism guarantee is precisely that the
+    same (p, proposal, key) commits the same token no matter which
+    program reaches the position first; two hand-rolled copies would
+    make that invariant disciplinary instead of structural."""
+    u = jax.random.uniform(key)
+    pd = p_row[prop]
+    onehot = jax.nn.one_hot(prop, p_row.shape[-1], dtype=jnp.bool_)
+    r = jnp.where(onehot, 0.0, p_row)
+    resid = jax.random.categorical(
+        jax.random.fold_in(key, 1), jnp.log(r))
+    return jnp.where(u < pd, prop, resid).astype(jnp.int32)
+
+
+def spec_prefill_commit(logit, prop, base_key, seed, position,
+                        temperature: float,
+                        top_k: Optional[int] = None):
+    """First-token commit for a SPECULATIVE engine's prefill: apply the
+    SAME per-position accept-or-residual rule the verify core uses
+    (`spec_accept_per_slot`), against the drafter's proposal `prop` for
+    this position.  A spec engine must commit position i through ONE
+    rule no matter which program reaches it first — a preemption
+    re-admission lands position i on the prefill path while the
+    undisturbed run committed it mid-verify, and mixing plain
+    categorical sampling here with accept-or-residual there would break
+    the temperature>0 determinism guarantee even though both draw from
+    the exact target distribution.  Greedy short-circuits to the
+    identical argmax (`prop` prunes out of the compiled program)."""
+    if temperature == 0.0:
+        return sample_logits(logit, None, 0.0, top_k)
+    p = jax.nn.softmax(_top_k_filter(logit, top_k) / temperature,
+                       axis=-1)  # (B, V), B == 1 (per-request prefill)
+    key = request_position_key(base_key, seed, position)
+    return _accept_or_residual(p[0], prop, key)[None]
+
+
+def spec_accept_per_slot(logits, span, extra, base_key, seeds, nprod,
+                         temperature: float,
+                         top_k: Optional[int] = None):
+    """Speculative-decoding acceptance core (Leviathan et al.,
+    arXiv:2211.17192), for the serving verify step.
+
+    `logits`: (S, K+1, V) f32 — the TARGET model scored at every span
+    position; span offset j's logits are the target distribution for
+    the token at output index nprod+j.  `span`: (S, K+1) int32 =
+    [last committed token, d_1 .. d_K] — the drafter's K verifiable
+    proposals behind the committed head; `extra`: (S,) int32 = the
+    drafter's (K+1)-th proposal, consumed only by the bonus position's
+    sampling rule (below).  Returns (accepted, final): `accepted` (S,)
+    int32 in [0, K] is how many leading drafts commit; `final` (S,)
+    int32 is the ONE extra committed token, so each verify commits
+    accepted+1 tokens.
+
+    temperature == 0.0 short-circuits to TOKEN EQUALITY against the
+    target argmax (no keys materialize): the committed sequence is the
+    target's greedy sequence regardless of what the drafter proposed,
+    which is what makes greedy speculative output bit-identical to
+    `generate`.
+
+    temperature > 0 applies, at EVERY span position, one deterministic
+    accept-or-residual rule for a point-mass proposal (both drafters
+    propose deterministically, so q is a delta at d): with
+    u ~ U[0,1) keyed by request_position_key(seed, output index),
+    commit d iff u < p(d), else draw from the renormalized residual
+    (p with d zeroed) under fold(key, 1).  The marginal is EXACTLY the
+    target distribution either way (p(d) + (1-p(d)) * 0 for d;
+    (1-p(d)) * p(x)/(1-p(d)) for x != d) — the rule is a
+    reparameterization of sampling from p, which is why the BONUS
+    position (all K drafts accepted) runs the same rule against
+    `extra` instead of sampling p directly: as long as the drafter's
+    proposal for a position is a pure function of the committed prefix
+    (both drafters are autoregressively consistent), the committed
+    token at output index i is the same whether i lands mid-span, at a
+    rejection point, or at a bonus — so preemption, warm restart, and
+    journal recovery replays (whose spans REALIGN against the
+    undisturbed run's) still commit identical tokens."""
+    k = span.shape[1] - 1
+    if temperature == 0.0:
+        tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (S, K+1)
+        match = (tgt[:, :k] == span[:, 1:]).astype(jnp.int32)
+        acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        final = jnp.take_along_axis(tgt, acc[:, None], axis=1)[:, 0]
+        return acc.astype(jnp.int32), final
+
+    filt = _top_k_filter(logits, top_k) / temperature
+    p = jax.nn.softmax(filt, axis=-1)  # (S, K+1, V) f32
+    # the proposal at span offset j is span[j+1] (j < K) or extra (K)
+    props = jnp.concatenate(
+        [span[:, 1:], extra[:, None]], axis=1).astype(jnp.int32)
+    pd = jnp.take_along_axis(
+        p[:, :k], props[:, :k, None], axis=-1)[..., 0]  # (S, K)
+    positions = nprod[:, None] + jnp.arange(k)[None, :]
+
+    def u_one(seed, pos):
+        return jax.random.uniform(
+            request_position_key(base_key, seed, pos))
+
+    u = jax.vmap(jax.vmap(u_one, in_axes=(None, 0)))(
+        seeds, positions)  # (S, K)
+    acc = jnp.sum(
+        jnp.cumprod((u < pd).astype(jnp.int32), axis=1), axis=1)
+    # the final committed token at output index nprod+acc runs the ONE
+    # accept-or-residual rule against the proposal there: a rejection
+    # point re-fails its accept test (same key, same p(d)) and takes
+    # the residual; the bonus position accepts or residual-draws
+    # against `extra` — either way the committed token is the same
+    # pure function of (prefix, seed, index) every replay computes
+    d_at = jnp.take_along_axis(props, acc[:, None], axis=1)[:, 0]
+    p_at = jnp.take_along_axis(
+        p, acc[:, None, None], axis=1)[:, 0]  # (S, V)
+
+    def final_one(seed, pos, row, prop):
+        return _accept_or_residual(
+            row, prop, request_position_key(base_key, seed, pos))
+
+    final = jax.vmap(final_one)(seeds, nprod + acc, p_at, d_at)
+    return acc.astype(jnp.int32), final

@@ -18,15 +18,23 @@ tests/test_serving.py alongside the telemetry=off convention.
                 behind ServingEngine.recover)
   * `driver`  — synthetic Poisson-arrivals load driver + the serial
                 `generate()` baseline (bench + tests share it)
+  * `spec`    — speculative decoding: one shape-stable verify program
+                scoring k+1 draft-span positions per slot per tick
+  * `drafter` — draft proposers behind one interface: model-free
+                prompt-lookup ("ngram") and a small same-family draft
+                model ("model:<preset>" / "model:self")
 """
 
+from .drafter import ModelDrafter, NgramDrafter, make_drafter
 from .engine import Request, ServeConfig, ServingEngine
 from .guard import DecodeHealthGuard
 from .journal import RequestJournal, ServingKilled
 from .pool import KVPoolView, PagedKVPool, PageRef
+from .spec import SpecDecoder
 
 __all__ = [
     "Request", "ServeConfig", "ServingEngine",
     "DecodeHealthGuard", "RequestJournal", "ServingKilled",
     "KVPoolView", "PagedKVPool", "PageRef",
+    "SpecDecoder", "NgramDrafter", "ModelDrafter", "make_drafter",
 ]

@@ -133,7 +133,19 @@ def run_trace(engine, trace: Sequence[Arrival], *,
         k: round(sum(r.lat_components[k] for r in requests), 4)
         for k in ("queue", "prefill", "decode", "preempt", "restart")
     }
-    return {
+    # speculative-decoding aggregate (zeros stay absent: a spec-off
+    # trace reports exactly the pre-spec dict)
+    spec_proposed = sum(r.spec_proposed for r in requests)
+    spec = None
+    if spec_proposed:
+        spec_accepted = sum(r.spec_accepted for r in requests)
+        spec = {
+            "proposed": spec_proposed,
+            "accepted": spec_accepted,
+            "accept_rate": round(
+                spec_accepted / max(1, spec_proposed), 4),
+        }
+    out = {
         "outputs": {r.id: list(r.tokens) for r in requests},
         "requests": requests,
         "tokens": toks,
@@ -156,6 +168,9 @@ def run_trace(engine, trace: Sequence[Arrival], *,
         "evictions": engine._evictions,
         "preemptions": sum(r.preemptions for r in requests),
     }
+    if spec is not None:
+        out["spec"] = spec
+    return out
 
 
 def run_serial(model, params, trace: Sequence[Arrival], *,

@@ -29,6 +29,19 @@ immediately; it re-queues at the FRONT and later re-prefills from
 prompt + tokens-produced-so-far, which continues the exact sequence).
 A request that could never fit the pool at all is refused at submit().
 
+Speculative decoding (`spec_draft=` / `spec_k=`; serving/spec.py +
+serving/drafter.py): the decode step's ONE-token-per-slot contract
+relaxes to 1..k+1 — a drafter proposes up to k continuation tokens per
+slot, one shape-stable verify program scores all k+1 span positions
+through the same paged attention, and the acceptance core commits the
+longest target-exact prefix (greedy output bit-identical to
+`generate`; only VERIFIED tokens reach the request, the journal, or
+the pool — rejected draft K/V routes to the scratch block inside the
+verify program itself).  Growth/admission extend block ownership to
+the span horizon, the SLO shed price re-bases on wall per committed
+token, and the guard/journal/preemption machinery is shared: the spec
+path is one more decode implementation under the same scheduler.
+
 Fault posture (the serving robustness layer):
 
   * SLOs — `submit(..., deadline_s=)` attaches a completion deadline
@@ -177,6 +190,17 @@ class ServeConfig:
     # sheds within one tick window that count as a "shed burst" and
     # trigger a flight flush (overload postmortems need the lead-up too)
     shed_burst: int = 3
+    # speculative decoding (serving/spec.py): None = plain one-token
+    # decode (the exact pre-spec programs); "ngram" = model-free
+    # prompt-lookup drafter; "model:self" / "model:<preset>" = a small
+    # same-family draft model with its own cache (serving/drafter.py).
+    # Each tick the drafter proposes up to spec_k tokens per slot and
+    # ONE verify pass through the target commits 1..spec_k+1 of them —
+    # greedy output stays bit-identical to `generate` (acceptance is
+    # token equality), temperature>0 stays target-exact and
+    # deterministic under the (seed, position) keys.
+    spec_draft: Optional[str] = None
+    spec_k: int = 4
 
 
 class Request:
@@ -202,6 +226,10 @@ class Request:
         # across preemption/restart/recovery (module docstring)
         self.seed = self.id if seed is None else int(seed)
         self.tokens: List[int] = []  # generated (includes eos when hit)
+        # speculative-decoding accounting (stays 0 with spec off):
+        # drafts proposed for / accepted into this request's sequence
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.state = "queued"
         self.status: Optional[str] = None  # terminal: ok/shed/expired/failed
         self.finish_reason: Optional[str] = None
@@ -335,18 +363,33 @@ class ServingEngine:
             self._flight = None
         self._flight_reason: Optional[str] = None
         # per-tick wall split + scheduler counts (tick records + flight)
-        self._seg = {"prefill_s": 0.0, "decode_s": 0.0, "fetch_s": 0.0}
+        self._seg = {"prefill_s": 0.0, "decode_s": 0.0, "fetch_s": 0.0,
+                     "draft_s": 0.0}
         self._tick_counts = dict.fromkeys(
             ("admitted", "evicted", "preempted", "expired",
              "quarantined", "restarted"), 0)
         self._shed_seen = 0
-        # recent decode-step walls: the measured inter-token service
-        # time that prices deadline feasibility for queue shedding
+        # recent decode walls PER COMMITTED TOKEN: the measured
+        # inter-token service price for deadline feasibility.  On the
+        # plain path one tick commits one token per active slot, so the
+        # entry is just the tick's decode wall; under speculation a
+        # tick's wall divides by its per-slot token yield — the tick
+        # walls go bimodal (draft+verify vs plain) and yield-dependent,
+        # and pricing from the raw wall would over-fire shedding on
+        # cheap high-acceptance ticks
         self._gap_hist: Deque[float] = deque(maxlen=128)
+        # speculative-decoding accounting (engine lifetime)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_ticks = 0
+        self._spec_tokens = 0
         # chaos / fault-injection hooks (resilience/chaos.py)
         self._poison_pending: set = set()
         self._prefill_exc: Optional[BaseException] = None
-        self.last_logits = None  # (S, V) f32 of the last decode tick
+        # (S, V) f32 of the last PLAIN decode tick (debug surface; a
+        # speculative engine's verify logits are (S, K+1, V) and are
+        # consumed in-program — it leaves this None)
+        self.last_logits = None
 
         bt = config.block_tokens
         temp, top_k = config.temperature, config.top_k
@@ -384,6 +427,39 @@ class ServingEngine:
         self._prefill_fn = jax.jit(prefill_step, donate_argnums=(5,))
         # "h.*" compute-dtype cast once — params are frozen while serving
         self._stacked = jax.jit(model.stacked_compute_params)(params)
+        # speculative decoding: the drafter + ONE compiled verify
+        # program (serving/spec.py); imported lazily so the spec-off
+        # engine's import graph — and its compiled programs — are
+        # exactly the pre-spec ones
+        if config.spec_draft is not None:
+            from ..models.sampling import spec_prefill_commit
+            from .spec import SpecDecoder
+            self._spec = SpecDecoder(model, params, config, base_key,
+                                     max_seq=self.max_seq)
+            # the span horizon: growth/admission must own blocks out to
+            # pos + spec_k so accepted drafts' K/V always land in-table
+            self._span_k = config.spec_k
+
+            def prefill_step_spec(params, stacked, prompt, last_pos,
+                                  block_ids, view, seed, nprod, prop):
+                logits, view = model.paged_prefill(
+                    params, prompt, last_pos, block_ids, view, bt,
+                    stacked=stacked,
+                )
+                # a spec engine commits EVERY position through the one
+                # accept-or-residual rule — `prop` is the drafter's
+                # proposal for this position, so a re-admission (whose
+                # first token lands here instead of mid-verify) draws
+                # the same token the undisturbed run committed
+                nxt = spec_prefill_commit(logits, prop, base_key, seed,
+                                          nprod, temp, top_k)
+                return nxt, view
+
+            self._prefill_fn = jax.jit(prefill_step_spec,
+                                       donate_argnums=(5,))
+        else:
+            self._spec = None
+            self._span_k = 0
 
     # -- public API ---------------------------------------------------------
 
@@ -452,7 +528,8 @@ class ServingEngine:
         kill leaves no engine to restart."""
         t0 = time.monotonic()
         tick_i = self._ticks
-        self._seg = {"prefill_s": 0.0, "decode_s": 0.0, "fetch_s": 0.0}
+        self._seg = {"prefill_s": 0.0, "decode_s": 0.0, "fetch_s": 0.0,
+                     "draft_s": 0.0}
         self._tick_counts = dict.fromkeys(self._tick_counts, 0)
         try:
             produced = self._tick_body()
@@ -591,11 +668,13 @@ class ServingEngine:
 
     def describe(self) -> str:
         q = self.config.quant or str(jnp.dtype(self.pool.view.k.dtype))
+        spec = (f", {self._spec.describe()}"
+                if self._spec is not None else "")
         return (
             f"serving(max_active={self.config.max_active}, "
             f"blocks={self.pool.num_usable}x"
             f"{self.config.block_tokens}, cache={q}, "
-            f"guard={'on' if self._guard else 'off'})"
+            f"guard={'on' if self._guard else 'off'}{spec})"
         )
 
     # -- scheduler internals ------------------------------------------------
@@ -612,73 +691,182 @@ class ServingEngine:
         active = [(i, s) for i, s in enumerate(self._slots)
                   if s is not None]
         if active:
-            S = self.config.max_active
-            tokens = np.zeros((S,), np.int32)
-            pos = np.zeros((S,), np.int32)
-            seeds = np.zeros((S,), np.int32)
-            nprod = np.zeros((S,), np.int32)
-            poison = np.zeros((S,), np.float32)
-            tables = np.full((S, self.max_blocks_per_req), SCRATCH_BLOCK,
-                             np.int32)
-            for i, s in active:
-                tokens[i] = s.last
-                pos[i] = s.pos
-                seeds[i] = s.req.seed
-                nprod[i] = len(s.req.tokens)
-                tables[i, :len(s.table)] = s.table
-            if self._poison_pending:
-                for i in self._poison_pending:
-                    poison[i] = np.nan
-                self._poison_pending.clear()
-            t_dec = time.monotonic()
-            nxt, logits, bad, view = self._decode_fn(
-                self.params, self._stacked, self.pool.view,
-                tokens, pos, tables, seeds, nprod, poison,
-            )
-            # dispatch returns before the device finishes (async); the
-            # np.asarray token fetch below is the sync — the tick record
-            # splits the two (decode_s vs fetch_s)
-            t_disp = time.monotonic()
-            self.pool.view = view
-            self.last_logits = logits
-            nxt = np.asarray(nxt)
-            # same computation, already synchronized by the token fetch
-            bad = np.asarray(bad)
-            tnow = time.monotonic()
-            self._seg["decode_s"] += t_disp - t_dec
-            self._seg["fetch_s"] += tnow - t_disp
-            self._gap_hist.append(tnow - t_dec)
-            poisoned = (set(self._guard.observe(bad, [i for i, _ in
-                                                      active]))
-                        if self._guard is not None else set())
-            for i, s in active:
-                if i in poisoned:
-                    self._quarantine(i, s)
-                    continue
-                t = int(nxt[i])
-                s.pos += 1
-                s.last = t
-                self._append_token(s.req, t, tnow)
-                if self.journal is not None:
-                    self.journal.tokens(s.req.id, [t])
-                produced += 1
-                if self._finished(s.req):
-                    self._finish(i, s)
-            if self._guard is not None and self._guard.should_restart:
-                self._warm_restart(
-                    f"{self._guard.consecutive_poisoned} consecutive "
-                    "poisoned decode ticks"
-                )
+            if self._spec is not None:
+                produced += self._decode_spec(active)
+            else:
+                produced += self._decode_plain(active)
         else:
             # no decode step ran: a poison armed for this tick must not
             # linger and hit whatever occupies the slot ticks later
             self._poison_pending.clear()
         return produced
 
+    def _slot_arrays(self, active):
+        """The decode/verify programs' per-slot operand vectors (empty
+        slots carry scratch coordinates — branch-free, shape-stable)."""
+        S = self.config.max_active
+        tokens = np.zeros((S,), np.int32)
+        pos = np.zeros((S,), np.int32)
+        seeds = np.zeros((S,), np.int32)
+        nprod = np.zeros((S,), np.int32)
+        poison = np.zeros((S,), np.float32)
+        tables = np.full((S, self.max_blocks_per_req), SCRATCH_BLOCK,
+                         np.int32)
+        for i, s in active:
+            tokens[i] = s.last
+            pos[i] = s.pos
+            seeds[i] = s.req.seed
+            nprod[i] = len(s.req.tokens)
+            tables[i, :len(s.table)] = s.table
+        if self._poison_pending:
+            for i in self._poison_pending:
+                poison[i] = np.nan
+            self._poison_pending.clear()
+        return tokens, pos, seeds, nprod, poison, tables
+
+    def _decode_plain(self, active) -> int:
+        """One token for every active slot — the exact pre-speculation
+        decode tick (spec off compiles and runs only this path)."""
+        produced = 0
+        tokens, pos, seeds, nprod, poison, tables = \
+            self._slot_arrays(active)
+        t_dec = time.monotonic()
+        nxt, logits, bad, view = self._decode_fn(
+            self.params, self._stacked, self.pool.view,
+            tokens, pos, tables, seeds, nprod, poison,
+        )
+        # dispatch returns before the device finishes (async); the
+        # np.asarray token fetch below is the sync — the tick record
+        # splits the two (decode_s vs fetch_s)
+        t_disp = time.monotonic()
+        self.pool.view = view
+        self.last_logits = logits
+        nxt = np.asarray(nxt)
+        # same computation, already synchronized by the token fetch
+        bad = np.asarray(bad)
+        tnow = time.monotonic()
+        self._seg["decode_s"] += t_disp - t_dec
+        self._seg["fetch_s"] += tnow - t_disp
+        self._gap_hist.append(tnow - t_dec)
+        poisoned = (set(self._guard.observe(bad, [i for i, _ in
+                                                  active]))
+                    if self._guard is not None else set())
+        for i, s in active:
+            if i in poisoned:
+                self._quarantine(i, s)
+                continue
+            t = int(nxt[i])
+            s.pos += 1
+            s.last = t
+            self._append_token(s.req, t, tnow)
+            if self.journal is not None:
+                self.journal.tokens(s.req.id, [t])
+            produced += 1
+            if self._finished(s.req):
+                self._finish(i, s)
+        if self._guard is not None and self._guard.should_restart:
+            self._warm_restart(
+                f"{self._guard.consecutive_poisoned} consecutive "
+                "poisoned decode ticks"
+            )
+        return produced
+
+    def _decode_spec(self, active) -> int:
+        """Speculative tick: drafter proposes up to K tokens per slot,
+        ONE verify pass through the target scores all K+1 span
+        positions, and 1..K+1 tokens commit per surviving slot.  Only
+        VERIFIED tokens ever reach the request, the journal, or the
+        pool (the verify program routes rejected-draft K/V to scratch);
+        quarantine, the watchdog, and the deadline machinery see the
+        same per-slot surface as the plain path."""
+        k = self._spec.k
+        produced = 0
+        t_draft = time.monotonic()
+        drafts = self._spec.propose(self._slots)  # (S, K+1) int32
+        t_mid = time.monotonic()
+        self._seg["draft_s"] += t_mid - t_draft
+        tokens, pos, seeds, nprod, poison, tables = \
+            self._slot_arrays(active)
+        S = self.config.max_active
+        # [head, d_1..d_K, extra]: columns 0..K are the scored span,
+        # the trailing extra is the bonus position's proposal
+        span = np.zeros((S, k + 2), np.int32)
+        span[:, 0] = tokens
+        span[:, 1:] = drafts
+        # the last position whose K/V this request will ever need
+        # (total-2: the final token's K/V is never read); -1 parks
+        # empty slots at count 0 — every write routes to scratch
+        limit_kv = np.full((S,), -1, np.int32)
+        for i, s in active:
+            limit_kv[i] = (len(s.req.prompt) + s.req.max_new_tokens - 2)
+        t_dec = time.monotonic()
+        acc, final, bad, view = self._spec.verify(
+            self.params, self._stacked, self.pool.view,
+            span, pos, tables, seeds, nprod, limit_kv, poison,
+        )
+        t_disp = time.monotonic()
+        self.pool.view = view
+        acc = np.asarray(acc)
+        final = np.asarray(final)
+        bad = np.asarray(bad)
+        tnow = time.monotonic()
+        self._seg["decode_s"] += t_disp - t_dec
+        self._seg["fetch_s"] += tnow - t_disp
+        poisoned = (set(self._guard.observe(bad, [i for i, _ in
+                                                  active]))
+                    if self._guard is not None else set())
+        eos = self.config.eos_id
+        committed = 0
+        for i, s in active:
+            if i in poisoned:
+                self._quarantine(i, s)
+                continue
+            n_acc = int(acc[i])
+            toks = [int(t) for t in span[i, 1:1 + n_acc]]
+            toks.append(int(final[i]))
+            remaining = s.req.max_new_tokens - len(s.req.tokens)
+            toks = toks[:remaining]
+            if eos is not None and eos in toks:
+                toks = toks[:toks.index(eos) + 1]  # keep the eos itself
+            s.req.spec_proposed += k
+            s.req.spec_accepted += min(n_acc, len(toks))
+            self._spec_proposed += k
+            self._spec_accepted += min(n_acc, len(toks))
+            for t in toks:
+                self._append_token(s.req, t, tnow)
+            if self.journal is not None:
+                self.journal.tokens(s.req.id, toks)
+            s.pos += len(toks)
+            s.last = toks[-1]
+            produced += len(toks)
+            committed += len(toks)
+            if self._finished(s.req):
+                self._finish(i, s)
+        # deadline price: this tick's wall per COMMITTED token — the
+        # draft+verify wall amortizes over the span yield, so a
+        # high-acceptance tick prices CHEAPER per token than its raw
+        # (bimodal) wall suggests
+        wall = tnow - t_draft
+        if committed:
+            self._gap_hist.append(wall * len(active) / committed)
+            self._spec_ticks += 1
+            self._spec_tokens += committed
+        if self._guard is not None and self._guard.should_restart:
+            self._warm_restart(
+                f"{self._guard.consecutive_poisoned} consecutive "
+                "poisoned decode ticks"
+            )
+        return produced
+
     def _gap_p50(self) -> Optional[float]:
-        """Median measured decode-tick wall — the inter-token service
-        price for deadline feasibility.  None until warm (a cold
-        engine's first walls are XLA compiles, not service time)."""
+        """Median measured decode wall PER COMMITTED TOKEN — the
+        inter-token service price for deadline feasibility.  On the
+        plain path each entry is a decode-tick wall (one token per slot
+        per tick); under speculation each entry is the tick wall scaled
+        by its per-slot token yield, so shedding prices the tokens
+        actually delivered instead of over-firing on the bimodal
+        draft+verify tick walls.  None until warm (a cold engine's
+        first walls are XLA compiles, not service time)."""
         if len(self._gap_hist) < _MIN_GAP_SAMPLES:
             return None
         return float(np.median(np.asarray(self._gap_hist)))
@@ -741,8 +929,13 @@ class ServingEngine:
             # on a block boundary — without the extra block that first
             # decode write would land in the scratch block (lost K/V),
             # or need a _grow after admission that can preempt the
-            # admission itself
-            ids = self.pool.alloc(p // bt + 1)
+            # admission itself.  Under speculation the first write is a
+            # whole span (positions p..p+spec_k), so the horizon —
+            # clamped to the request's final position — replaces p:
+            # same worst-case block count as the plain path, claimed up
+            # front instead of across the first few grows
+            ids = self.pool.alloc(
+                self._write_horizon(req, p) // bt + 1)
             if ids is None:
                 break
             self._queue.popleft()
@@ -775,11 +968,26 @@ class ServingEngine:
             k = min(len(ids), bucket // bt)
             block_ids[:k] = ids[:k]
             try:
-                nxt, view = self._prefill_fn(
-                    self.params, self._stacked, padded, p - 1, block_ids,
-                    self.pool.view, np.int32(req.seed),
-                    np.int32(len(req.tokens)),
-                )
+                if self._spec is not None:
+                    # the drafter rebuilds this slot's draft cache from
+                    # the SAME committed prefix — the one admission
+                    # path every resume (preemption, warm restart,
+                    # recovery) rides, so drafter state never needs
+                    # separate fault handling — and hands back its
+                    # proposal for the first post-prefix position (the
+                    # spec prefill's accept-or-residual operand)
+                    prop = self._spec.on_admit(slot_i, prompt_now)
+                    nxt, view = self._prefill_fn(
+                        self.params, self._stacked, padded, p - 1,
+                        block_ids, self.pool.view, np.int32(req.seed),
+                        np.int32(len(req.tokens)), np.int32(prop),
+                    )
+                else:
+                    nxt, view = self._prefill_fn(
+                        self.params, self._stacked, padded, p - 1,
+                        block_ids, self.pool.view, np.int32(req.seed),
+                        np.int32(len(req.tokens)),
+                    )
                 self.pool.view = view
                 tok = int(np.asarray(nxt)[0])
             except Exception:
@@ -813,15 +1021,31 @@ class ServingEngine:
                 self._finish(slot_i, slot)
         return produced
 
+    def _write_horizon(self, req: Request, pos: int) -> int:
+        """The furthest position this slot's NEXT decode step may write:
+        `pos` on the plain path (byte-for-byte the pre-spec behavior),
+        `pos + spec_k` under speculation (the whole draft span's K/V
+        must land in owned blocks), clamped to the request's LAST
+        WRITABLE position total-2 — the final token's K/V is never
+        written (nothing attends past it; the verify program's
+        limit_kv routes those offsets to scratch), so growing a block
+        for it would burst the plain path's worst-case block count and
+        preempt neighbors for storage nobody fills."""
+        if not self._span_k:
+            return pos
+        total = len(req.prompt) + req.max_new_tokens
+        return min(pos + self._span_k, total - 2)
+
     def _grow(self) -> None:
-        """Allocate the next block for any slot whose write position
+        """Allocate the next block for any slot whose write horizon
         crossed a block boundary; on exhaustion, preempt the youngest
         active request until the grower fits (or is itself preempted)."""
         for i, slot in enumerate(self._slots):
             if slot is None or self._slots[i] is not slot:
                 continue
             while (self._slots[i] is slot
-                   and len(slot.table) < slot.pos
+                   and len(slot.table)
+                   < self._write_horizon(slot.req, slot.pos)
                    // self.config.block_tokens + 1):
                 ids = self.pool.alloc(1)
                 if ids is not None:
@@ -998,6 +1222,11 @@ class ServingEngine:
             )
             if req.last_slot is not None:
                 rec["slot"] = req.last_slot
+            if self._spec is not None:
+                # per-request speculation yield: drafts proposed for /
+                # accepted into this sequence (accept rate = ratio)
+                rec["spec_proposed"] = req.spec_proposed
+                rec["spec_accepted"] = req.spec_accepted
             if req.deadline_s is not None:
                 rec["deadline_s"] = req.deadline_s
             if req.t_admitted is not None:
@@ -1049,6 +1278,11 @@ class ServingEngine:
         t.gauge("serve_expired", float(self._expired))
         t.gauge("serve_quarantined", float(self._quarantined))
         t.gauge("serve_restarts", float(self._restarts))
+        if self._spec is not None:
+            t.gauge("serve_spec_accept_rate",
+                    self._spec_accepted / max(1, self._spec_proposed))
+            t.gauge("serve_spec_tokens_per_tick",
+                    self._spec_tokens / max(1, self._spec_ticks))
 
     # -- per-tick time series + serving flight recorder ---------------------
 
@@ -1087,7 +1321,7 @@ class ServingEngine:
         wall = time.monotonic() - t0
         seg = self._seg
         sched = max(0.0, wall - seg["prefill_s"] - seg["decode_s"]
-                    - seg["fetch_s"])
+                    - seg["fetch_s"] - seg["draft_s"])
         shed_delta = self._shed - self._shed_seen
         self._shed_seen = self._shed
         if shed_delta >= self.config.shed_burst:
@@ -1106,6 +1340,12 @@ class ServingEngine:
             decode_s=round(seg["decode_s"], 6),
             fetch_s=round(seg["fetch_s"], 6),
         )
+        if self._spec is not None:
+            # the draft-vs-verify wall split: draft_s is the drafter's
+            # proposal wall, decode_s+fetch_s the verify program's —
+            # only spec runs emit the field, so spec-off tick records
+            # are byte-identical to the pre-spec schema
+            segments["draft_s"] = round(seg["draft_s"], 6)
         if self._flight is not None:
             # the ring reuses FlightRecorder's schema: the tick's state +
             # counts ride the `health` dict, the wall split `segments`

@@ -150,6 +150,53 @@ def paged_panel(view: KVPoolView, l, page: PageRef, out_dtype):
     return panel(view.k, view.k_scale), panel(view.v, view.v_scale)
 
 
+def paged_append_span(view: KVPoolView, ks, vs, tables, pos0, count,
+                      block_tokens: int) -> KVPoolView:
+    """Commit a verified SPAN of tokens' K/V per slot — the speculative
+    decoding multi-token append.  ks/vs: (L, S, KVH, K1, Dh) span K/V
+    stacks (the verify scan's ys: span offset j is the token at absolute
+    position pos0[s]+j); tables: (S, W) block tables; pos0: (S,) span
+    base positions; count: (S,) int32 in [0, K1] — how many leading span
+    offsets COMMIT.  Offsets >= count (rejected drafts, inactive slots,
+    positions past the request's K/V horizon) route to the scratch block
+    and never enter the pool, so acceptance truncates the write itself:
+    no rejected-draft K/V to clean up, boundary-exact per slot (the
+    block index comes through the slot's own table, same as the single-
+    token `paged_append`).  One scatter per side covers all L layers."""
+    L, S, KVH, K1, Dh = ks.shape
+    j = jnp.arange(K1)[None, :]
+    wpos = pos0[:, None] + j  # (S, K1) absolute write positions
+    valid = j < count[:, None]
+    W = tables.shape[1]
+    # clamp the table lookup BEFORE masking: an invalid offset's write
+    # position may index past the table, and OOB gather clamping would
+    # otherwise read a real block id that the where() must override
+    bidx = jnp.minimum(wpos // block_tokens, W - 1)
+    blk = jnp.take_along_axis(tables, bidx, axis=1)
+    blk = jnp.where(valid, blk, SCRATCH_BLOCK)
+    off = jnp.where(valid, wpos % block_tokens, 0)
+
+    def prep(a):  # (L, S, KVH, K1, Dh) -> (S*K1, L, KVH, Dh) slabs
+        return a.transpose(1, 3, 0, 2, 4).reshape(S * K1, L, KVH, Dh)
+
+    kb, vb = prep(ks), prep(vs)
+    bf, of = blk.reshape(-1), off.reshape(-1)
+    mode = quant_mode(view)
+    if mode is None:
+        return view._replace(
+            k=view.k.at[bf, of].set(kb.astype(view.k.dtype)),
+            v=view.v.at[bf, of].set(vb.astype(view.v.dtype)),
+        )
+    qk, sk = _quant_vectors(kb, mode)
+    qv, sv = _quant_vectors(vb, mode)
+    return KVPoolView(
+        k=view.k.at[bf, of].set(qk),
+        v=view.v.at[bf, of].set(qv),
+        k_scale=view.k_scale.at[bf, of].set(sk),
+        v_scale=view.v_scale.at[bf, of].set(sv),
+    )
+
+
 def paged_scatter(view: KVPoolView, ks, vs, block_ids,
                   block_tokens: int) -> KVPoolView:
     """Scatter a prefill's full-prompt K/V — ks/vs (L, 1, KVH, P, Dh)

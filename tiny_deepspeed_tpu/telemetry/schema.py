@@ -42,13 +42,20 @@ _NUM = (int, float)
 #      (ok/shed/expired/failed) + optional deadline_s; fault records may
 #      carry a `slot`; serve_shed / serve_expired / serve_quarantined /
 #      serve_restarts gauges
-#   6: + serving observability (this PR): `tick` meta kind (per-tick wall
+#   6: + serving observability: `tick` meta kind (per-tick wall
 #      split + scheduler counters), request records grow the lifecycle
 #      `events` timeline and the latency attribution components
 #      (lat_s / comp_*_s), run_meta may carry the `serve` config dict
 #      (what the trace viewer needs to lay out slot tracks), and the
 #      dcn_wire_bytes gauge (per-link ICI-vs-DCN ledger split)
-SCHEMA_VERSION = 6
+#   7: + speculative decoding (this PR): tick records carry the drafter
+#      wall `draft_s` (the draft-vs-verify split; decode_s/fetch_s are
+#      the verify side), request records carry spec_proposed /
+#      spec_accepted (per-request draft yield), and the
+#      serve_spec_accept_rate / serve_spec_tokens_per_tick gauges —
+#      all emitted ONLY by spec-enabled engines, so spec-off files are
+#      byte-compatible with v6 readers
+SCHEMA_VERSION = 7
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -205,6 +212,12 @@ META_FIELDS: Dict[str, tuple] = {
     "comp_decode_s": _NUM,
     "comp_preempt_s": _NUM,
     "comp_restart_s": _NUM,
+    # speculative decoding (schema v7, spec-enabled engines only):
+    # per-request draft yield — drafts proposed for this sequence and
+    # drafts accepted into it (accept rate = accepted/proposed; the
+    # committed sequence itself is target-exact either way)
+    "spec_proposed": int,
+    "spec_accepted": int,
     # tick record (serving scheduler; schema v6).  t_s is the tick-start
     # stamp on the same monotonic clock as request `events`; wall_s the
     # full tick wall; sched_s/prefill_s/decode_s/fetch_s partition it
@@ -217,6 +230,10 @@ META_FIELDS: Dict[str, tuple] = {
     "prefill_s": _NUM,
     "decode_s": _NUM,
     "fetch_s": _NUM,
+    # drafter proposal wall (schema v7, spec-enabled engines only) —
+    # the draft side of the draft-vs-verify tick split; decode_s +
+    # fetch_s are the verify program's dispatch + sync walls
+    "draft_s": _NUM,
     "occupancy": _NUM,          # active slots / max_active after the tick
     "pool_util": _NUM,          # allocated / usable pool blocks
     "queue_depth": int,
@@ -391,4 +408,13 @@ GAUGES: Dict[str, str] = {
                       "processes) on the hybrid mesh — measured from "
                       "the compiled HLO's replica_groups, not modeled "
                       "(utils/hlo_comm.wire_link_split)",
+    "serve_spec_accept_rate": "speculative decoding: drafts accepted / "
+                              "drafts proposed, engine lifetime — the "
+                              "drafter-quality number that decides "
+                              "whether speculation pays",
+    "serve_spec_tokens_per_tick": "speculative decoding: committed "
+                                  "tokens per verify tick (1..k+1), "
+                                  "engine lifetime — the realized "
+                                  "multi-token yield vs the plain "
+                                  "path's fixed 1.0",
 }

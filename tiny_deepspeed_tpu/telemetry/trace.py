@@ -301,9 +301,14 @@ _TID_SLOT0 = 3       # decode slot s -> tid _TID_SLOT0 + s
 
 _WAIT_LABELS = {"queue": "queue wait", "preempt": "preempted wait",
                 "restart": "restart wait"}
-_TICK_SEG_ORDER = ("sched_s", "prefill_s", "decode_s", "fetch_s")
+_TICK_SEG_ORDER = ("sched_s", "draft_s", "prefill_s", "decode_s",
+                   "fetch_s")
 _TICK_SEG_NAMES = {"sched_s": "host scheduling", "prefill_s": "prefill",
-                   "decode_s": "decode dispatch", "fetch_s": "token fetch"}
+                   "decode_s": "decode dispatch", "fetch_s": "token fetch",
+                   # speculative engines only (schema v7): the drafter's
+                   # proposal wall; decode dispatch + token fetch are
+                   # then the VERIFY program's spans
+                   "draft_s": "draft propose"}
 
 
 def has_serving_records(metas: List[dict]) -> bool:
