@@ -171,6 +171,19 @@ def _load_last_good():
         return None
 
 
+# outcome of this invocation's backend-init probe, stamped as
+# extra.backend_probe on EVERY emitted BENCH record (including cached
+# substitutions): the TPU probe has timed out every round since r05
+# while the headline stayed the cached value, and only ROADMAP prose
+# recorded it — the staleness signal belongs in the JSON itself
+_BACKEND_PROBE = {"status": "not_run", "duration_s": 0.0}
+
+
+def _stamp_probe(rec: dict) -> dict:
+    rec.setdefault("extra", {})["backend_probe"] = dict(_BACKEND_PROBE)
+    return rec
+
+
 def _devices_with_timeout(timeout_s: int):
     """Backend-init probe with a hard timeout: the axon tunnel has been
     observed to HANG at init (not error) for hours, blocked inside native
@@ -179,23 +192,32 @@ def _devices_with_timeout(timeout_s: int):
     failure raises with the transient UNAVAILABLE signature so
     _retry_or_diagnose re-execs with backoff; on probe success the caller
     initializes the backend in-process (fresh connection, probe just
-    proved it comes up)."""
+    proved it comes up).  The outcome (ok / timeout / error + measured
+    duration) lands in _BACKEND_PROBE for the record stamp."""
     import subprocess
+    t0 = time.time()
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
             timeout=timeout_s, capture_output=True, text=True,
         )
     except subprocess.TimeoutExpired:
+        _BACKEND_PROBE.update(status="timeout",
+                              duration_s=round(time.time() - t0, 1),
+                              timeout_s=timeout_s)
         raise RuntimeError(
             f"UNAVAILABLE: backend init probe timed out after {timeout_s}s "
             "(hung tunnel)"
         )
     if r.returncode != 0:
+        _BACKEND_PROBE.update(status="error",
+                              duration_s=round(time.time() - t0, 1))
         raise RuntimeError(
             f"UNAVAILABLE: backend init probe failed rc={r.returncode}: "
             f"{r.stderr[-300:]}"
         )
+    _BACKEND_PROBE.update(status="ok",
+                          duration_s=round(time.time() - t0, 1))
     import jax
     return jax.devices()
 
@@ -234,14 +256,14 @@ def _retry_or_diagnose(exc: BaseException) -> None:
         mode = ("spec" if os.environ.get("BENCH_SPEC")
                 else "serve" if os.environ.get("BENCH_SERVE")
                 else "decode")
-        print(json.dumps({
+        print(json.dumps(_stamp_probe({
             "metric": f"{model_name}_{mode}_tokens_per_sec",
             "value": 0.0,
             "unit": "tokens/s",
             "vs_baseline": 0.0,
             "extra": {"error": repr(exc)[:500], "attempts": attempt + 1,
                       "transient": transient},
-        }))
+        })))
         sys.exit(0)
     hit = _load_last_good() if (transient and _default_config()) else None
     if hit is not None and hit[0].get("metric", "").startswith(model_name):
@@ -271,9 +293,9 @@ def _retry_or_diagnose(exc: BaseException) -> None:
         # measurement of THIS invocation — buried in extra, trajectory
         # tooling treated the number as fresh
         cached["stale"] = True
-        print(json.dumps(cached))
+        print(json.dumps(_stamp_probe(cached)))
         sys.exit(0)
-    print(json.dumps({
+    print(json.dumps(_stamp_probe({
         "metric": f"{model_name}_train_tokens_per_sec_per_chip",
         "value": 0.0,
         "unit": "tokens/s/chip",
@@ -283,7 +305,7 @@ def _retry_or_diagnose(exc: BaseException) -> None:
             "attempts": attempt + 1,
             "transient": transient,
         },
-    }))
+    })))
     sys.exit(0)
 
 
@@ -1118,7 +1140,7 @@ def main():
                         time.sleep(20)
                         continue
                     break
-            print(json.dumps(rec), flush=True)
+            print(json.dumps(_stamp_probe(rec)), flush=True)
         return
 
     model_name = os.environ.get("BENCH_MODEL", "gpt2-124m")
@@ -1128,17 +1150,17 @@ def main():
         if os.environ.get("BENCH_SPEC"):
             rec = run_spec_ab(model_name)
             rec["vs_baseline"] = rec["extra"]["speedup"]
-            print(json.dumps(rec))
+            print(json.dumps(_stamp_probe(rec)))
             return
         if os.environ.get("BENCH_SERVE"):
             rec = run_serve(model_name)
             rec["vs_baseline"] = 1.0
-            print(json.dumps(rec))
+            print(json.dumps(_stamp_probe(rec)))
             return
         if os.environ.get("BENCH_DECODE"):
             rec = run_decode(model_name, b=int(b) if b else 8)
             rec["vs_baseline"] = 1.0
-            print(json.dumps(rec))
+            print(json.dumps(_stamp_probe(rec)))
             return
         rec = run_one(model_name, b=int(b) if b else None, t=t)
     except Exception as e:  # noqa: BLE001 - diagnose/retry
@@ -1154,8 +1176,10 @@ def main():
     else:
         rec["vs_baseline"] = round(rec["value"] / prev, 3)
     if _default_config():
+        # the cache stores the UNstamped record: a later round's replay
+        # stamps its OWN probe outcome (the whole point of the stamp)
         _save_last_good(rec)
-    print(json.dumps(rec))
+    print(json.dumps(_stamp_probe(rec)))
 
 
 if __name__ == "__main__":

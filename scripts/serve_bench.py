@@ -89,7 +89,22 @@ def main(argv=None) -> int:
     p.add_argument("--chaos-delay-s", type=float, default=0.25,
                    help="tick-delay fault duration")
     p.add_argument("--journal", default=None, metavar="PATH",
-                   help="append the crash-recovery request journal here")
+                   help="append the crash-recovery request journal here "
+                        "(fleet mode derives per-replica paths "
+                        "PATH.rN from it; default for --replicas: "
+                        "artifacts/fleet_journal.jsonl)")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="fleet mode: N engine replicas behind the "
+                        "SLO-aware FleetRouter (fleet/router.py); each "
+                        "replica gets its own journal so a chaos "
+                        "engine_kill@T (which kills replica 0) fails "
+                        "over onto a sibling mid-trace")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated mode: prefill and decode on "
+                        "separate engines with priced paged-KV "
+                        "migration between their pools "
+                        "(fleet/disagg.py); per-request migration "
+                        "bytes/link land on the request records")
     p.add_argument("--spec-draft", default=None, metavar="DRAFTER",
                    help="speculative decoding drafter: 'ngram' "
                         "(model-free prompt lookup), 'model:self', or "
@@ -177,15 +192,30 @@ def main(argv=None) -> int:
                         quant=args.kv_quant or "off",
                         spec_draft=args.spec_draft or "off",
                         spec_k=args.spec_k,
+                        replicas=args.replicas,
+                        disagg=bool(args.disagg),
                     ))
         return lg
 
     # CLI validation BEFORE the sidecar writer truncates anything: an
     # invalid invocation must not destroy the previous run's records
-    if args.chaos and "journal_kill" in args.chaos and not args.journal:
+    if args.replicas < 1:
+        p.error("--replicas must be >= 1")
+    if args.disagg and args.replicas > 1:
+        p.error("--disagg and --replicas are separate modes (a fleet "
+                "of disagg pairs is not wired yet)")
+    if args.disagg and args.chaos:
+        p.error("--chaos targets a single engine or fleet replica 0; "
+                "not supported with --disagg")
+    if args.disagg and args.spec_draft:
+        p.error("--disagg does not compose with --spec-draft (drafter "
+                "state only rebuilds through the prefill admission "
+                "path)")
+    if (args.chaos and "journal_kill" in args.chaos
+            and not args.journal and args.replicas == 1):
         p.error("--chaos journal_kill@N needs --journal PATH (the kill "
                 "fires inside the journal's commit, and recovery "
-                "replays it)")
+                "replays it); fleet mode auto-assigns journals")
 
     logger = make_logger(jsonl_path)
 
@@ -199,19 +229,80 @@ def main(argv=None) -> int:
     from tiny_deepspeed_tpu.serving import RequestJournal
     from tiny_deepspeed_tpu.serving.driver import Arrival
 
-    def warmed_engine():
-        e = ServingEngine(model, params, serve_cfg)
-        warm = [
-            Arrival(0.0, [0] * plen, min(2, args.max_new_tokens))
-            for plen in sorted(set(prompt_lens))
-        ]
-        run_trace(e, warm, realtime=False)
-        if args.journal:
-            e.journal = RequestJournal(args.journal)
+    warm_trace = [
+        Arrival(0.0, [0] * plen, min(2, args.max_new_tokens))
+        for plen in sorted(set(prompt_lens))
+    ]
+
+    def warmed_engine(journal_path=None, replica_id=None):
+        e = ServingEngine(model, params, serve_cfg,
+                          replica_id=replica_id)
+        run_trace(e, warm_trace, realtime=False)
+        if journal_path:
+            e.journal = RequestJournal(journal_path)
         return e
 
-    eng = warmed_engine()
-    eng.telemetry, eng.logger = tel, logger
+    def replica_journal(i, tag=""):
+        base = args.journal or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..",
+            "artifacts", "fleet_journal.jsonl")
+        root, ext = os.path.splitext(base)
+        path = f"{root}{tag}.r{i}{ext or '.jsonl'}"
+        # per-run scratch, like the sidecar: journals open in APPEND
+        # mode (recovery continues one file), so a stale file from the
+        # previous invocation would resurrect ITS interrupted requests
+        # at this run's first failover — and pin this run to its
+        # geometry stamp
+        if os.path.exists(path):
+            os.remove(path)
+        return path
+
+    def build_target(telemetry, logger, chaos=None, tag=""):
+        """The measured object for this pass: a single engine, a fleet
+        router over N warmed replicas, or a disaggregated pair —
+        telemetry/logger attached AFTER warm in every mode, so warm
+        requests pollute neither counters nor the sidecar."""
+        if args.disagg:
+            from tiny_deepspeed_tpu.fleet import DisaggEngine
+            dis = DisaggEngine(model, params, serve_cfg)
+            run_trace(dis, warm_trace, realtime=False)
+            # warm requests migrated too — zero the counters so the
+            # summary prices the MEASURED trace only (their records
+            # never reached the sidecar: logger AND journal attach
+            # after warm, same as the other modes — warm requests
+            # must not enter the crash-recovery WAL either)
+            dis.migrations = 0
+            dis.migrated_bytes = 0
+            dis.bytes_by_link = {}
+            j = RequestJournal(args.journal) if args.journal else None
+            for e in (dis.prefill, dis.decode):
+                e.telemetry, e.logger = telemetry, logger
+                e.journal = j  # shared WAL (geometry stamped per attach)
+            dis.telemetry = telemetry
+            return dis
+        if args.replicas > 1:
+            from tiny_deepspeed_tpu.fleet import FleetRouter
+            from tiny_deepspeed_tpu.resilience import ChaosServingEngine
+            engines = []
+            for i in range(args.replicas):
+                e = warmed_engine(replica_journal(i, tag), replica_id=i)
+                e.telemetry, e.logger = telemetry, logger
+                engines.append(e)
+            if chaos is not None:
+                # chaos faults target replica 0 — an engine_kill there
+                # exercises the failover path while siblings keep
+                # serving
+                engines[0] = ChaosServingEngine(engines[0], chaos)
+            # parallel ticks: replicas are independent engines and XLA
+            # releases the GIL mid-program — on a multi-core host this
+            # is where replica-count scaling comes from
+            return FleetRouter(engines, telemetry=telemetry,
+                               logger=logger, parallel=True)
+        e = warmed_engine(args.journal)
+        e.telemetry, e.logger = telemetry, logger
+        return e
+
+    eng = build_target(tel, logger)
     res = run_trace(eng, trace, realtime=realtime)
     res.pop("outputs")
     res.pop("requests")
@@ -239,6 +330,16 @@ def main(argv=None) -> int:
     if "spec" in res:
         summary["spec"] = dict(res["spec"], drafter=args.spec_draft,
                                k=args.spec_k)
+    if args.replicas > 1:
+        summary["fleet"] = {
+            "replicas": args.replicas,
+            "replicas_live": len(eng._live()),
+            "failovers": eng.failovers,
+            "dispatch": {str(k): v
+                         for k, v in eng.dispatch_counts().items()},
+        }
+    if args.disagg:
+        summary["disagg"] = eng.migration_summary()
 
     if args.chaos:
         # goodput under faults, A/B on the SAME trace: the clean pass
@@ -258,18 +359,32 @@ def main(argv=None) -> int:
             chaos_jsonl = f"{root}.chaos{ext or '.jsonl'}"
         tel2 = Telemetry()
         logger2 = make_logger(chaos_jsonl)
-        ceng = ChaosServingEngine(warmed_engine(), chaos)
-        ceng.engine.telemetry, ceng.engine.logger = tel2, logger2
+        if args.replicas > 1:
+            # fleet: the router ITSELF absorbs replica death (incl.
+            # engine_kill / journal_kill on replica 0) by journal-replay
+            # failover — the A/B shows the goodput cost of losing and
+            # recovering a whole engine mid-trace
+            ceng = build_target(tel2, logger2, chaos=chaos,
+                                tag=".chaos")
+        else:
+            ceng = ChaosServingEngine(build_target(tel2, logger2),
+                                      chaos)
         try:
             cres = run_trace(ceng, trace, realtime=realtime)
         except ServingKilled:
+            # In fleet mode the router absorbs replica deaths by
+            # failover; a ServingKilled escaping run_trace means the
+            # LAST live replica died — total fleet loss is a real
+            # outcome, and a FleetRouter has no recover() to pretend
+            # otherwise with
+            if args.replicas > 1:
+                raise
             # the journal_kill fault "killed" the engine mid-commit;
             # demonstrate the recovery recipe end-to-end: a fresh
             # engine replays the journal and finishes the in-flight
             # requests (arrivals not yet submitted died with the
             # process, exactly as a real crash loses them)
-            reng = warmed_engine()
-            reng.telemetry, reng.logger = tel2, logger2
+            reng = build_target(tel2, logger2)
             rec = reng.recover()
             reng.drain()
             cres = None
@@ -301,6 +416,9 @@ def main(argv=None) -> int:
                     cres["ok_tokens_per_s"]
                     / max(res["ok_tokens_per_s"], 1e-9), 3),
             }
+            if args.replicas > 1:
+                summary["chaos"]["failovers"] = ceng.failovers
+                summary["chaos"]["replicas_live"] = len(ceng._live())
     if args.serial:
         from tiny_deepspeed_tpu.serving.driver import run_serial
         ser = run_serial(model, params, trace,
@@ -318,6 +436,16 @@ def main(argv=None) -> int:
     print(f"outcomes: ok {sc['ok']} / shed {sc['shed']} / "
           f"expired {sc['expired']} / failed {sc['failed']} "
           f"(goodput {res['ok_tokens_per_s']} tok/s)")
+    if args.replicas > 1:
+        fl = summary["fleet"]
+        print(f"fleet: {fl['replicas_live']}/{fl['replicas']} replicas "
+              f"live, dispatch {fl['dispatch']}, "
+              f"failovers {fl['failovers']}")
+    if args.disagg:
+        dg = summary["disagg"]
+        print(f"disagg: {dg['migrations']} prefill->decode migrations, "
+              f"{dg['migrated_bytes'] / 1024:.1f} KiB KV moved "
+              f"({dg['bytes_by_link']})")
     if "spec" in summary:
         sp = summary["spec"]
         print(f"speculation [{sp['drafter']} k={sp['k']}]: "
@@ -332,8 +460,12 @@ def main(argv=None) -> int:
                   f"{args.journal} -> {ch['recovered_ok']} ok")
         else:
             cc = ch["status_counts"]
+            fo = (f", {ch['failovers']} failover(s) "
+                  f"({ch['replicas_live']}/{args.replicas} replicas "
+                  "left)" if "failovers" in ch else "")
             print(f"chaos [{ch['spec']}]: {ch['faults_injected']} "
-                  f"faults, {ch['restarts']} restarts -> ok {cc['ok']} "
+                  f"faults, {ch['restarts']} restarts{fo} -> ok "
+                  f"{cc['ok']} "
                   f"/ shed {cc['shed']} / expired {cc['expired']} / "
                   f"failed {cc['failed']}; goodput "
                   f"{ch['ok_tokens_per_s']} tok/s "

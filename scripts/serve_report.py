@@ -28,6 +28,10 @@ operational questions the percentile headline cannot:
   * per-tick time series summary: tick-wall split (host scheduling vs
     prefill vs decode dispatch vs token fetch), occupancy / pool /
     queue-depth ranges, and the fault counters.
+  * fleet section (schema v8, fleet/disagg runs): per-replica request/
+    goodput/p99 breakdown keyed on `replica_id`, the router's failover
+    fault records, and the disaggregated prefill->decode KV-migration
+    totals (measured bytes, by ICI/DCN link class).
 
 Exit codes: 0 ok; 1 parse errors in the JSONL (partial report rendered);
 2 missing/empty input or no serving records at all.
@@ -216,6 +220,54 @@ def render_serve_report(metas: List[dict], source: str = "") -> str:
                        f"({draft / max(draft + verify, 1e-9):.0%} of "
                        "decode time spent drafting)")
         out.append("")
+
+    # -- fleet --------------------------------------------------------------
+    by_rep: Dict[int, List[dict]] = {}
+    for r in reqs:
+        if isinstance(r.get("replica_id"), int):
+            by_rep.setdefault(r["replica_id"], []).append(r)
+    failovers = [m for m in metas if m.get("kind") == "fault"
+                 and m.get("fault") == "fleet_failover"]
+    migrated = [r for r in reqs
+                if isinstance(r.get("kv_migration_bytes"), int)]
+    if by_rep or failovers or migrated:
+        out.append("## Fleet\n")
+        if by_rep:
+            out.append("| replica | requests | ok | tokens | "
+                       "p99 latency |")
+            out.append("|---|---|---|---|---|")
+            for rid in sorted(by_rep):
+                rs = by_rep[rid]
+                oks = [r for r in rs if r.get("status") == "ok"]
+                lats = [r["lat_s"] for r in rs
+                        if isinstance(r.get("lat_s"), (int, float))]
+                out.append(
+                    f"| {rid} | {len(rs)} | {len(oks)} | "
+                    f"{sum(r.get('new_tokens', 0) for r in rs)} | "
+                    f"{_ms(_quantile(lats, 0.99)) if lats else '-'} |")
+            out.append("")
+        for f in failovers:
+            out.append(f"- failover at tick {f.get('at_step', '?')}: "
+                       f"{f.get('action', '?')}")
+        if failovers:
+            out.append("")
+        if migrated:
+            total = sum(r["kv_migration_bytes"] for r in migrated)
+            by_link: Dict[str, int] = {}
+            for r in migrated:
+                link = str(r.get("kv_migration_link", "?"))
+                by_link[link] = by_link.get(link, 0) \
+                    + r["kv_migration_bytes"]
+            out.append(
+                f"- disaggregated KV migration: {len(migrated)} "
+                f"request(s), {total / 1024:.1f} KiB moved "
+                "prefill -> decode (" + ", ".join(
+                    f"{k} {v / 1024:.1f} KiB"
+                    for k, v in sorted(by_link.items()))
+                + ") — per-request bytes are measured from the payload "
+                  "arrays, the link from the wire_link_split granule "
+                  "logic")
+            out.append("")
 
     # -- SLO headroom -------------------------------------------------------
     slo = [(float(r["deadline_s"]) - float(r["lat_s"])) for r in served

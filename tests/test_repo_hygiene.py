@@ -286,3 +286,69 @@ def test_serving_spec_schema_v7_names():
         "spec_proposed": 12, "spec_accepted": 9,
     })
     assert not errs, errs
+
+
+def test_fleet_schema_v8_names():
+    """Schema-v8 drift guard (fleet serving): the router gauges must
+    stay documented AND registered by fleet/router.py, the engine must
+    stamp replica_id / kv_migration_* on its records, the chaos
+    harness must keep the engine_kill kind the failover tests key on —
+    and v8 records must validate, else `report_run.py --check`
+    hard-fails every fleet sidecar."""
+    from tiny_deepspeed_tpu.telemetry import schema
+
+    assert schema.SCHEMA_VERSION >= 8
+    v8_gauges = {"fleet_dispatch", "fleet_failover",
+                 "fleet_replicas_live"}
+    assert v8_gauges <= set(schema.GAUGES), (
+        v8_gauges - set(schema.GAUGES))
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "fleet", "router.py")) as f:
+        router_src = f.read()
+    for g in sorted(v8_gauges):
+        assert f'"{g}"' in router_src, (
+            f"gauge {g} documented in schema but no longer registered "
+            "by fleet/router.py"
+        )
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "serving", "engine.py")) as f:
+        engine_src = f.read()
+    for name in ("replica_id", "kv_migration_bytes",
+                 "kv_migration_link"):
+        assert name in schema.META_FIELDS, name
+        assert name in engine_src, (
+            f"{name} gone from serving/engine.py record stamping"
+        )
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "resilience", "chaos.py")) as f:
+        chaos_src = f.read()
+    assert "engine_kill" in chaos_src, (
+        "chaos engine_kill kind gone — the fleet failover A/B and "
+        "tests key on it"
+    )
+    # a fleet request record (replica + migration attribution) and a
+    # replica-stamped tick record validate
+    errs = schema.validate_record({
+        "kind": "request", "ts": 0.0, "request_id": 1,
+        "prompt_tokens": 4, "new_tokens": 8, "preemptions": 0,
+        "status": "ok", "finish": "length", "replica_id": 1,
+        "kv_migration_bytes": 7168, "kv_migration_link": "dcn",
+    })
+    assert not errs, errs
+    errs = schema.validate_record({
+        "kind": "tick", "ts": 0.0, "tick": 3, "t_s": 1.25,
+        "wall_s": 0.01, "sched_s": 0.001, "prefill_s": 0.004,
+        "decode_s": 0.004, "fetch_s": 0.001, "occupancy": 0.5,
+        "pool_util": 0.25, "queue_depth": 1, "admitted": 1,
+        "evicted": 0, "preempted": 0, "shed": 0, "expired": 0,
+        "quarantined": 0, "restarted": 0, "produced": 2,
+        "replica_id": 0, "emit": "event",
+    })
+    assert not errs, errs
+    # the failover fault record the router writes
+    errs = schema.validate_record({
+        "kind": "fault", "ts": 0.0, "fault": "fleet_failover",
+        "at_step": 4, "replica_id": 0,
+        "action": "replica 0 died; journal replayed onto replica 1",
+    })
+    assert not errs, errs

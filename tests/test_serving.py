@@ -280,7 +280,12 @@ class TestQuantizedCache:
             bound = np.abs(ref_a).max(-1, keepdims=True) / 127.0 * 0.5001
             assert (np.abs(got - ref_a) <= bound + 1e-7).all()
 
-    @pytest.mark.parametrize("quant", ["int8", "fp8"])
+    # fp8 demoted to slow (ISSUE-12 tier-1 budget): the fp8 codec is
+    # primitive-pinned by the quick roundtrip-bound test and the decode
+    # integration path is identical per mode — the int8 case keeps the
+    # quantized-decode wiring quick
+    @pytest.mark.parametrize("quant", [
+        "int8", pytest.param("fp8", marks=pytest.mark.slow)])
     def test_quantized_decode_parity_tolerance(self, model, params,
                                                quant):
         """Quantized-cache greedy decode tracks the f32 reference: the
@@ -517,6 +522,11 @@ class TestDecodeHealthGuard:
         )
         assert eng.restarts == 0  # one poisoned tick < k_restart
 
+    # demoted to slow (ISSUE-12 tier-1 budget): neighbor survival under
+    # quarantine stays pinned by the slow chaos soak (every unpoisoned
+    # request token-exact under a multi-fault schedule); the quick
+    # quarantine-storm test keeps the freed-exactly-once accounting
+    @pytest.mark.slow
     def test_neighbor_survives_quarantine_token_exact(self, model,
                                                       params):
         from tiny_deepspeed_tpu.serving import ServingEngine
@@ -534,6 +544,11 @@ class TestDecodeHealthGuard:
             err_msg="neighbor diverged across a quarantine",
         )
 
+    # demoted to slow (ISSUE-12 tier-1 budget): the watchdog-restart
+    # resume path stays quick via test_tick_exception_warm_restart
+    # (same restart machinery, one compile cheaper) and the consecutive-
+    # poison trip predicate is unit-level in DecodeHealthGuard
+    @pytest.mark.slow
     def test_watchdog_restart_after_consecutive_poison(self, model,
                                                        params):
         """k_restart consecutive poisoned ticks trip ONE warm restart;
@@ -618,6 +633,12 @@ class TestRequestJournal:
         with pytest.raises(ValueError, match="corrupt journal"):
             RequestJournal.replay(p)
 
+    # demoted to slow (ISSUE-12 tier-1 budget): same-engine recover
+    # parity is subsumed quick by test_chaos_journal_kill_then_recover
+    # (recover after a REAL lost tick) and by the fleet failover pin
+    # (tests/test_fleet.py: journal replay onto a sibling, active AND
+    # queued requests, token-identical)
+    @pytest.mark.slow
     def test_recover_continues_token_exact(self, model, params,
                                            tmp_path):
         """Abandon an engine mid-flight (requests active AND queued);
@@ -703,6 +724,12 @@ class TestRequestJournal:
 
 
 class TestTemperatureDeterminism:
+    # demoted to slow (ISSUE-12 tier-1 budget): the (seed, position)
+    # key identity is unit-pinned quick in TestSamplingCore, and the
+    # engine-level temp>0 tight-vs-roomy resume determinism stays
+    # pinned by the slow spec-decoding determinism tests (both
+    # drafters) plus this test in the slow tier
+    @pytest.mark.slow
     def test_preemption_resume_deterministic_nongreedy(self, model,
                                                        params):
         """temperature > 0: a preempted-and-resumed request re-samples

@@ -1,0 +1,39 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Fleet serving tier: N engine replicas behind an SLO-aware router.
+
+The serving package (`tiny_deepspeed_tpu/serving/`) is one engine — one
+pool, one journal, one SLO policy.  Real deployments run fleets: this
+package composes N `ServingEngine` replicas into one front door, the way
+the TPU-vs-GPU serving analysis lays out (PAPERS.md arXiv:2605.25645).
+
+  * `router`   — FleetRouter: SLO-aware least-loaded dispatch over the
+                 replicas (queue depth, pool headroom, the measured
+                 median decode-tick price, per-replica health), door
+                 shedding for deadlines no replica can meet, and the
+                 failover trigger when a replica dies mid-tick.
+  * `failover` — journal-replay failover: a dead replica's write-ahead
+                 log replays onto a sibling via the existing
+                 `ServingEngine.recover()` path — ids preserved, the
+                 callers' request handles adopted, greedy outputs
+                 token-identical to an uninterrupted run.
+  * `disagg`   — DisaggEngine: prefill and decode split onto separate
+                 engines with a priced paged-KV block migration between
+                 their pools (`migrate` / the engine export/import
+                 hooks), the ICI-vs-DCN cost of each handoff measured
+                 by the `wire_link_split` granule logic.
+
+Everything here is host-side orchestration over the SAME compiled
+serving programs — no new device code, and a 1-replica fleet runs the
+exact single-engine tick.
+"""
+
+from .disagg import DisaggEngine, migration_link
+from .failover import EngineKilled, fail_over
+from .router import FleetRouter
+
+__all__ = [
+    "FleetRouter", "DisaggEngine", "EngineKilled", "fail_over",
+    "migration_link",
+]

@@ -1,0 +1,246 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Disaggregated serving: prefill and decode on SEPARATE engines, with
+a priced paged-KV migration between their pools.
+
+Why split: prefill is compute-bound and bursty (one big matmul panel
+per admission), decode is memory-bound and steady (one token per slot
+per tick) — on one engine every prefill stalls the whole decode batch
+for its wall (the `tick` records' prefill_s spikes).  Disaggregation
+gives each phase its own engine: the PREFILL engine runs
+admission-only ticks (`ServingEngine.tick(decode=False)`) that fill
+pool blocks and sample first tokens; each prefilled request then
+migrates — `ServingEngine.export_request` gathers its blocks out of
+the prefill pool in the pool's RESTING dtype, `import_request`
+scatters them into the decode engine's pool and seats the slot at the
+same (pos, last) coordinates, no re-prefill.  A quantized pool
+(`quant="int8"|"fp8"`) therefore migrates 1-byte blocks + scales: the
+handoff gets the same 4x compression the pool rests at, for free.
+
+The handoff is PRICED, not modeled: `kv_migration_bytes` is summed
+from the payload arrays' own dtypes/shapes, and `kv_migration_link`
+classifies the transfer with `wire_link_split`'s granule logic — a
+source/destination device set inside one DCN granule (slice/process)
+rides ICI, anything spanning granules is billed to DCN.  Both land on
+the request's JSONL record, so the disaggregation tax is a per-request
+measured number in the dashboard (scripts/serve_report.py "Fleet").
+
+Caveats, by construction: speculative decoding is refused (drafter
+state only rebuilds through the prefill admission path); a decode-side
+preemption or warm restart re-prefills ON the decode engine (its
+`_admit` path — correctness first, phase purity second), so only the
+first admission of each request is guaranteed to run on the prefill
+engine.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Dict, Optional, Union
+
+from ..serving.engine import ServeConfig, ServingEngine
+from ..serving.journal import RequestJournal
+from ..serving.pool import payload_bytes
+
+
+def migration_link(src_devices, dst_devices, *,
+                   granule_of: Optional[Dict[int, int]] = None,
+                   dst_granule: Optional[int] = None) -> str:
+    """"ici" or "dcn" for a transfer between two device sets — the
+    `wire_link_split` granule logic applied to ONE handoff instead of a
+    collective's replica group: devices inside one DCN granule
+    (slice_index, else process_index) exchange over ICI; a transfer
+    spanning granules must cross DCN and is billed there entirely.
+
+    `granule_of` overrides the attribute-derived granules by device id
+    (the same CPU-emulation idiom `wire_link_split` uses); `dst_granule`
+    forces every DESTINATION device into that granule — how a CPU-mesh
+    test, whose one physical device can never span granules, emulates a
+    decode engine living on another slice."""
+    src = list(src_devices)
+    dst = list(dst_devices)
+
+    def granule(d, forced=None):
+        if forced is not None:
+            return forced
+        if granule_of is not None:
+            return granule_of.get(d.id, d.id)
+        for attr in ("slice_index", "process_index"):
+            if hasattr(d, attr):
+                return getattr(d, attr)
+        return 0
+
+    grans = ({granule(d) for d in src}
+             | {granule(d, dst_granule) for d in dst})
+    return "dcn" if len(grans) > 1 else "ici"
+
+
+class DisaggEngine:
+    """A prefill engine and a decode engine behind one driver surface.
+
+    `config` shapes the DECODE engine (slots, pool, SLOs);
+    `prefill_config` defaults to the same geometry — the pools MUST
+    share block_tokens / max_seq_tokens / quant (import validates, a
+    mismatch raises naming both sides), but prefill may run fewer
+    slots.  `journal` (path or instance) is SHARED: both engines
+    append to one WAL, so `recover()` on either side replays the whole
+    pair's requests.
+
+    Each tick: the prefill engine runs an admission-only tick, every
+    parked prefilled slot migrates to the decode engine while it has a
+    free slot + blocks (oldest admission first, head-of-line like the
+    admission queue), then the decode engine runs a full tick.  A
+    request that cannot migrate yet parks in its prefill slot — pool
+    pressure on the decode side backs admission up into the prefill
+    engine, which is the disaggregation flow-control story."""
+
+    def __init__(self, model, params, config: ServeConfig = ServeConfig(),
+                 *, prefill_config: Optional[ServeConfig] = None,
+                 telemetry=None, logger=None,
+                 journal: Union[None, str, RequestJournal] = None,
+                 granule_of: Optional[Dict[int, int]] = None,
+                 decode_granule: Optional[int] = None,
+                 prefill_replica: int = 0, decode_replica: int = 1):
+        if config.spec_draft is not None or (
+                prefill_config is not None
+                and prefill_config.spec_draft is not None):
+            raise ValueError(
+                "disaggregated serving does not compose with "
+                "speculative decoding (spec_draft) — the drafter state "
+                "only rebuilds through the prefill admission path, "
+                "which import_request bypasses"
+            )
+        pcfg = prefill_config or config
+        for knob in ("block_tokens", "max_seq_tokens", "quant"):
+            if getattr(pcfg, knob) != getattr(config, knob):
+                raise ValueError(
+                    f"prefill/decode pool geometry must match to "
+                    f"migrate blocks: {knob}="
+                    f"{getattr(pcfg, knob)!r} (prefill) vs "
+                    f"{getattr(config, knob)!r} (decode)"
+                )
+        j = RequestJournal(journal) if isinstance(journal, str) else journal
+        self.prefill = ServingEngine(model, params, pcfg,
+                                     telemetry=telemetry, logger=logger,
+                                     journal=j,
+                                     replica_id=prefill_replica)
+        self.decode = ServingEngine(model, params, config,
+                                    telemetry=telemetry, logger=logger,
+                                    journal=j, replica_id=decode_replica)
+        self.granule_of = granule_of
+        self.decode_granule = decode_granule
+        self.telemetry = telemetry
+        self.migrations = 0
+        self.migrated_bytes = 0
+        self.bytes_by_link: Dict[str, int] = {}
+
+    # -- scheduling ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens, *, deadline_s=None,
+               seed=None):
+        return self.prefill.submit(prompt, max_new_tokens,
+                                   deadline_s=deadline_s, seed=seed)
+
+    def tick(self) -> int:
+        produced = self.prefill.tick(decode=False)
+        self._migrate()
+        produced += self.decode.tick()
+        return produced
+
+    def drain(self, max_ticks: Optional[int] = None) -> int:
+        total = 0
+        ticks = 0
+        while self.queue_depth or self.n_active:
+            total += self.tick()
+            ticks += 1
+            if max_ticks is not None and ticks > max_ticks:
+                raise RuntimeError(
+                    f"disagg drain exceeded {max_ticks} ticks with "
+                    f"{self.queue_depth} queued"
+                )
+        return total
+
+    def _migrate(self) -> None:
+        """Move every parked prefilled request the decode engine can
+        seat right now, oldest admission first; stop at the first that
+        does not fit (head-of-line, like FIFO admission — skipping
+        ahead would starve long requests exactly when the pool is
+        tight)."""
+        occupied = sorted(
+            ((i, s) for i, s in enumerate(self.prefill._slots)
+             if s is not None),
+            key=lambda js: js[1].admitted_at,
+        )
+        for i, s in occupied:
+            if not self.decode.can_import(len(s.table)):
+                break
+            handoff = self.prefill.export_request(i)
+            nbytes = payload_bytes(handoff.payload)
+            link = migration_link(
+                handoff.payload.k.devices(),
+                self.decode.pool.view.k.devices(),
+                granule_of=self.granule_of,
+                dst_granule=self.decode_granule,
+            )
+            seated = self.decode.import_request(handoff)
+            assert seated, "can_import said yes but import_request no"
+            req = handoff.req
+            req.kv_migration_bytes += nbytes
+            req.kv_migration_link = link
+            self.migrations += 1
+            self.migrated_bytes += nbytes
+            self.bytes_by_link[link] = (
+                self.bytes_by_link.get(link, 0) + nbytes)
+
+    # -- single-engine driver surface ---------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return self.prefill.queue_depth + self.decode.queue_depth
+
+    @property
+    def n_active(self) -> int:
+        return self.prefill.n_active + self.decode.n_active
+
+    @property
+    def restarts(self) -> int:
+        return self.prefill.restarts + self.decode.restarts
+
+    @property
+    def _evictions(self) -> int:
+        return self.prefill._evictions + self.decode._evictions
+
+    @property
+    def config(self) -> SimpleNamespace:
+        return SimpleNamespace(
+            max_active=(self.prefill.config.max_active
+                        + self.decode.config.max_active))
+
+    @property
+    def pool(self) -> SimpleNamespace:
+        """Aggregate accounting for the driver's pool-utilization
+        series (both pools' blocks count — a request holds blocks in
+        exactly one of them at a time)."""
+        p, d = self.prefill.pool, self.decode.pool
+        merged = d.kv_bytes()
+        for k, v in p.kv_bytes().items():
+            if isinstance(v, int):
+                merged[k] = merged[k] + v
+        return SimpleNamespace(
+            num_usable=p.num_usable + d.num_usable,
+            blocks_in_use=p.blocks_in_use + d.blocks_in_use,
+            blocks_free=p.blocks_free + d.blocks_free,
+            kv_bytes=lambda m=merged: m,
+        )
+
+    def migration_summary(self) -> dict:
+        return {
+            "migrations": self.migrations,
+            "migrated_bytes": self.migrated_bytes,
+            "bytes_by_link": dict(self.bytes_by_link),
+        }
+
+    def describe(self) -> str:
+        return (f"disagg(prefill={self.prefill.describe()}, "
+                f"decode={self.decode.describe()})")

@@ -1,0 +1,70 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Journal-replay failover: requests survive ENGINE loss, not just
+process restart.
+
+The PR-8 request journal already makes one engine's requests durable
+across its own death (`ServingEngine.recover()` in a fresh process).
+The fleet generalizes the reader: when replica A dies mid-trace — the
+chaos `engine_kill` fault, or any real exception escalating out of its
+tick — replica B replays A's journal and finishes A's requests.  Three
+properties make the handoff exact and invisible to callers:
+
+  * ids preserved — the journal carries them, and `recover()` bumps the
+    shared id counter past everything the dead journal issued;
+  * handles adopted — `recover(adopt=)` resets the callers' EXISTING
+    Request objects to the committed prefix instead of minting new
+    ones, so a `submit()`-returned handle keeps accumulating tokens
+    through the failover;
+  * token-identical — the (seed, position) sampling keys make the
+    continuation a pure function of (params, prompt, seed); the tokens
+    lost with the dead engine's uncommitted buffer re-decode to the
+    same values (the headline fleet acceptance, pinned in
+    tests/test_fleet.py at temperature 0 by argmax equality).
+
+The sibling also RE-JOURNALS every adopted request into its own WAL
+(recover()'s cross-journal path), so a second failure replays from the
+sibling's journal alone — failover chains.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..serving.engine import Request, ServingEngine
+from ..serving.journal import ServingKilled
+
+
+class EngineKilled(ServingKilled):
+    """A whole serving replica died — the chaos stand-in for an engine
+    host going away (resilience/chaos.py `engine_kill`).  Like its base
+    ServingKilled, the engine must NOT catch this and warm-restart: a
+    dead replica has no engine to restart.  The FLEET router catches it
+    one level up and replays the journal onto a sibling."""
+
+
+def fail_over(dead: ServingEngine, sibling: ServingEngine, *,
+              adopt: Optional[Dict[int, Request]] = None
+              ) -> List[Request]:
+    """Move a dead replica's in-flight requests onto `sibling`.
+
+    `dead` is abandoned first (active windows closed into the
+    restart-overhead component, queue cleared, journal file closed
+    WITHOUT committing its buffer — on disk the WAL looks exactly as a
+    SIGKILL would leave it), then the sibling replays it through the
+    geometry-validated `recover()` path.  Returns the re-queued
+    handles, adopted from `adopt` where ids match.  Raises ValueError
+    when the dead replica has no journal — without a WAL there is
+    nothing durable to replay, which is why the router requires
+    journals on fleet replicas."""
+    journal = dead.journal
+    if journal is None:
+        raise ValueError(
+            "dead replica has no journal — its in-flight requests left "
+            "no durable trace to replay onto a sibling; construct fleet "
+            "replicas with journal="
+        )
+    path = journal.path
+    dead.abandon()
+    return sibling.recover(journal=path, adopt=adopt)

@@ -1,0 +1,376 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""FleetRouter: the front door over N serving-engine replicas.
+
+Dispatch is SLO-aware and least-loaded, scored per replica from numbers
+the engines already measure:
+
+  * load — queue depth plus fractional slot occupancy, PRICED by the
+    replica's measured median decode wall per committed token
+    (`_gap_p50`, the PR-8 shed price): a replica that serves tokens
+    slowly counts its backlog as proportionally heavier;
+  * pool headroom — allocated / usable paged-KV blocks;
+  * health — the decode-health guard's quarantine and warm-restart
+    counts: a replica that keeps poisoning slots or restarting is
+    de-prioritized before it is dead.
+
+Deadlines are honored AT DISPATCH: a request whose `deadline_s` no live
+replica prices as meetable sheds at the door (terminal status "shed",
+finish "shed:fleet_unmeetable") instead of burning a replica's queue
+just to be shed there ticks later — the same measured gap price the
+engines use, applied one level earlier.
+
+Failover: any exception out of a replica's tick — the chaos
+`engine_kill`, a `ServingKilled` from its journal, a restart-storm
+RuntimeError — marks the replica dead and replays its journal onto the
+best-scored live sibling (fleet/failover.py).  Callers' request handles
+survive: `recover(adopt=)` resets the existing objects to the committed
+prefix, so `submit()`-returned requests keep working through engine
+loss.
+
+The router exposes the single-engine driver surface (submit / tick /
+drain / queue_depth / n_active / pool / config.max_active), so
+`serving.driver.run_trace` drives a fleet exactly like one engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..serving.engine import Request, ServingEngine
+from .failover import fail_over
+
+# health weight in the dispatch score: one quarantine/restart counts
+# like half a queued request — enough to steer traffic away from a
+# flapping replica without starving it outright
+_HEALTH_WEIGHT = 0.5
+
+
+class _LockedLogger:
+    """Serializes a shared MetricsLogger across concurrently ticking
+    replicas: each record line must hit the file whole.  Everything
+    else delegates."""
+
+    def __init__(self, logger, lock: threading.Lock):
+        self._logger = logger
+        self._lock = lock
+
+    def log(self, *a, **kw):
+        with self._lock:
+            return self._logger.log(*a, **kw)
+
+    def log_meta(self, *a, **kw):
+        with self._lock:
+            return self._logger.log_meta(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._logger, name)
+
+
+@dataclasses.dataclass
+class Replica:
+    """One engine behind the router.  `engine` is what the router
+    ticks (possibly a ChaosServingEngine wrapper); `raw` is the
+    underlying ServingEngine whose state the scores read."""
+
+    id: int
+    engine: object
+    raw: ServingEngine
+    alive: bool = True
+    dispatched: int = 0
+
+
+class _FleetPool:
+    """Aggregate pool accounting over the LIVE replicas — the facade
+    `run_trace`'s pool-utilization series reads."""
+
+    def __init__(self, router: "FleetRouter"):
+        self._router = router
+
+    def _live(self):
+        return [r.raw.pool for r in self._router.replicas if r.alive]
+
+    @property
+    def num_usable(self) -> int:
+        return sum(p.num_usable for p in self._live()) or 1
+
+    @property
+    def blocks_in_use(self) -> int:
+        return sum(p.blocks_in_use for p in self._live())
+
+    @property
+    def blocks_free(self) -> int:
+        return sum(p.blocks_free for p in self._live())
+
+    def kv_bytes(self) -> dict:
+        """Summed resting footprint across live replicas (dtype from
+        the first — replicas are homogeneous by construction)."""
+        per = [p.kv_bytes() for p in self._live()]
+        out = dict(per[0]) if per else {}
+        for k in ("kv_block_bytes", "scale_bytes", "total_bytes"):
+            out[k] = sum(d[k] for d in per)
+        return out
+
+
+class FleetRouter:
+    """N serving replicas behind one SLO-aware front door.
+
+    `engines` are pre-built (and pre-warmed, if the caller measures)
+    ServingEngine instances or ChaosServingEngine wrappers; each gets
+    its `replica_id` stamped from its position unless already set.
+    Failover needs per-replica journals — replicas without one still
+    serve, but their in-flight requests cannot replay if they die
+    (fail_over raises, naming the gap).
+
+    `telemetry` / `logger` are the ROUTER's: fleet_dispatch /
+    fleet_failover / fleet_replicas_live gauges and the failover fault
+    records.  Per-request and per-tick records come from the engines'
+    own telemetry/logger (share one across the fleet and the records
+    interleave, distinguished by their `replica_id` field)."""
+
+    def __init__(self, engines: Sequence[object], *, telemetry=None,
+                 logger=None, parallel: bool = False):
+        if not engines:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas: List[Replica] = []
+        for i, e in enumerate(engines):
+            raw = getattr(e, "engine", e)  # unwrap a chaos proxy
+            if raw.replica_id is None:
+                raw.replica_id = i
+            self.replicas.append(Replica(id=i, engine=e, raw=raw))
+        self.telemetry = telemetry
+        self.logger = logger
+        # parallel=True ticks the replicas on a thread pool: they are
+        # independent engines (own pool/programs/journal), XLA releases
+        # the GIL while a program runs, and a real fleet's replicas
+        # never wait on each other — on a multi-core host this is where
+        # replica-count throughput scaling actually comes from.  The
+        # default stays sequential: deterministic tick interleaving for
+        # tests and single-core boxes.  Shared-sink rules under
+        # concurrency: the MetricsLogger is lock-wrapped below (whole
+        # lines), telemetry Counters lock internally, Histogram.observe
+        # is a GIL-atomic append — and shared GAUGES are last-writer-
+        # wins across replicas, which is already their semantic when N
+        # engines write one registry sequentially.
+        self.parallel = bool(parallel)
+        self._pool_exec: Optional[ThreadPoolExecutor] = None
+        if self.parallel:
+            self._pool_exec = ThreadPoolExecutor(
+                max_workers=len(self.replicas),
+                thread_name_prefix="fleet-tick")
+            # a shared metrics sink must serialize whole lines once
+            # replicas tick concurrently
+            lock = threading.Lock()
+            seen: Dict[int, _LockedLogger] = {}
+            for r in self.replicas:
+                lg = r.raw.logger
+                if lg is not None:
+                    r.raw.logger = seen.setdefault(
+                        id(lg), _LockedLogger(lg, lock))
+        self._registry: Dict[int, Tuple[Request, Replica]] = {}
+        self._dispatched = 0
+        self._failovers = 0
+        self._door_sheds = 0
+        self._ticks = 0
+        self._update_gauges()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _live(self) -> List[Replica]:
+        return [r for r in self.replicas if r.alive]
+
+    def _score(self, r: Replica) -> Tuple[float, float, int]:
+        """Dispatch score, lower = better.  Primary: backlog priced by
+        the measured per-token decode wall, plus the health penalty;
+        secondary: pool pressure; final tie-break: replica id (a cold
+        even fleet fills deterministically, lowest id first)."""
+        eng = r.raw
+        gap = eng._gap_p50() or 0.0
+        load = (eng.queue_depth
+                + eng.n_active / max(1, eng.config.max_active))
+        health = eng._quarantined + eng._restarts
+        pool = eng.pool.blocks_in_use / eng.pool.num_usable
+        return (load * (1.0 + gap) + _HEALTH_WEIGHT * health, pool, r.id)
+
+    def _meets(self, r: Replica, max_new_tokens: int,
+               deadline_s: Optional[float]) -> bool:
+        """Can this replica plausibly serve `max_new_tokens` inside the
+        deadline?  Priced from ITS measured median decode wall per
+        committed token, exactly like the engine's own queue shedding
+        (+1 for the prefill it must pay); a cold replica (no price yet)
+        is optimistic — compile noise must not shed real traffic."""
+        if deadline_s is None:
+            return True
+        gap = r.raw._gap_p50()
+        return gap is None or (max_new_tokens + 1) * gap <= deadline_s
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               deadline_s: Optional[float] = None,
+               seed: Optional[int] = None) -> Request:
+        """Dispatch one request to the best live replica — or shed it
+        AT THE DOOR when no live replica prices its deadline as
+        meetable (the handle returns already terminal, exactly like an
+        engine watermark shed)."""
+        live = self._live()
+        if not live:
+            raise RuntimeError("no live replicas to dispatch to")
+        feasible = [r for r in live
+                    if self._meets(r, max_new_tokens, deadline_s)]
+        if not feasible:
+            # unmeetable everywhere: shed without touching any queue.
+            # The least-loaded replica's terminal path writes the
+            # record (its logger/telemetry own the request stream).
+            req = Request(list(prompt), int(max_new_tokens),
+                          deadline_s=deadline_s, seed=seed)
+            best = min(live, key=self._score)
+            best.raw._count("serve_submitted")
+            best.raw._shed_req(req, "fleet_unmeetable")
+            self._door_sheds += 1
+            self._update_gauges()
+            return req
+        r = min(feasible, key=self._score)
+        req = r.engine.submit(prompt, max_new_tokens,
+                              deadline_s=deadline_s, seed=seed)
+        r.dispatched += 1
+        self._dispatched += 1
+        if req.status is None:  # not shed at the replica's own door
+            self._registry[req.id] = (req, r)
+        self._update_gauges()
+        return req
+
+    # -- scheduling + failover ----------------------------------------------
+
+    def tick(self) -> int:
+        """One fleet step: tick every live replica that has work —
+        sequentially by default, concurrently on the thread pool with
+        `parallel=True` (replicas share nothing but the metrics sink,
+        which is lock-wrapped).  A replica whose tick raises is failed
+        over on the spot (its requests re-queue on a sibling THIS
+        tick, always from the router's thread) and the rest of the
+        fleet keeps serving."""
+        busy = [r for r in self._live()
+                if r.raw.queue_depth or r.raw.n_active]
+        produced = 0
+        if self.parallel and len(busy) > 1:
+            futures = [(r, self._pool_exec.submit(r.engine.tick))
+                       for r in busy]
+            # join EVERY future before any failover: recover() mutates
+            # the sibling's queue, which must not race its own tick
+            failures = []
+            for r, f in futures:
+                try:
+                    produced += f.result()
+                except Exception as e:  # noqa: BLE001 - replica death
+                    failures.append((r, e))
+            # mark EVERY failure dead before the first replay: with two
+            # deaths in one tick, the first failover must not pick the
+            # other doomed replica as its sibling
+            for r, _ in failures:
+                r.alive = False
+            for r, e in failures:
+                self._fail_over(r, e)
+        else:
+            for r in busy:
+                try:
+                    produced += r.engine.tick()
+                except Exception as e:  # noqa: BLE001 - replica death
+                    self._fail_over(r, e)
+        self._ticks += 1
+        self._update_gauges()
+        return produced
+
+    def drain(self, max_ticks: Optional[int] = None) -> int:
+        total = 0
+        ticks = 0
+        while self.queue_depth or self.n_active:
+            total += self.tick()
+            ticks += 1
+            if max_ticks is not None and ticks > max_ticks:
+                raise RuntimeError(
+                    f"fleet drain exceeded {max_ticks} ticks with "
+                    f"{self.queue_depth} queued"
+                )
+        return total
+
+    def _fail_over(self, r: Replica, exc: BaseException) -> None:
+        """Replica `r` died (`exc`): replay its journal onto the best
+        live sibling, adopting the callers' handles.  With no live
+        sibling left the exception propagates — there is nowhere for
+        the requests to go, and pretending otherwise would hide total
+        fleet loss."""
+        r.alive = False
+        live = self._live()
+        if not live:
+            raise exc
+        sibling = min(live, key=self._score)
+        adopted = {rid: req for rid, (req, rep) in self._registry.items()
+                   if rep is r}
+        recovered = fail_over(r.raw, sibling.raw, adopt=adopted)
+        for req in recovered:
+            self._registry[req.id] = (req, sibling)
+        self._failovers += 1
+        if self.logger is not None:
+            self.logger.log_meta(
+                kind="fault", fault="fleet_failover",
+                at_step=self._ticks, replica_id=r.id,
+                action=(f"replica {r.id} died "
+                        f"({type(exc).__name__}: {exc}); journal "
+                        f"replayed onto replica {sibling.id}, "
+                        f"{len(recovered)} request(s) re-queued"),
+            )
+
+    # -- single-engine driver surface ---------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(r.raw.queue_depth for r in self._live())
+
+    @property
+    def n_active(self) -> int:
+        return sum(r.raw.n_active for r in self._live())
+
+    @property
+    def restarts(self) -> int:
+        return sum(r.raw.restarts for r in self.replicas)
+
+    @property
+    def failovers(self) -> int:
+        return self._failovers
+
+    @property
+    def _evictions(self) -> int:
+        return sum(r.raw._evictions for r in self.replicas)
+
+    @property
+    def config(self) -> SimpleNamespace:
+        """The aggregate the driver's occupancy series divides by:
+        total live decode slots."""
+        return SimpleNamespace(max_active=sum(
+            r.raw.config.max_active for r in self._live()) or 1)
+
+    @property
+    def pool(self) -> _FleetPool:
+        return _FleetPool(self)
+
+    def describe(self) -> str:
+        live = self._live()
+        return (f"fleet({len(live)}/{len(self.replicas)} replicas live: "
+                + "; ".join(r.raw.describe() for r in live) + ")")
+
+    def dispatch_counts(self) -> Dict[int, int]:
+        """{replica id: requests dispatched to it} — what the
+        least-loaded test and the bench summary read."""
+        return {r.id: r.dispatched for r in self.replicas}
+
+    def _update_gauges(self) -> None:
+        if self.telemetry is None:
+            return
+        t = self.telemetry
+        t.gauge("fleet_dispatch", float(self._dispatched))
+        t.gauge("fleet_failover", float(self._failovers))
+        t.gauge("fleet_replicas_live", float(len(self._live())))

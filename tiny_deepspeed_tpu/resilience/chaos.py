@@ -47,6 +47,12 @@ counter counts `tick()` calls on the wrapper):
                      buffered tick is lost exactly as a SIGKILL at the
                      worst moment would lose it (exercises
                      ServingEngine.recover's re-decode of the tail).
+  * "engine_kill"  — EngineKilled raised OUT of the wrapped engine's
+                     tick: the whole replica dies (its host went away),
+                     which no warm restart may catch — the FLEET router
+                     catches it one level up and replays the dead
+                     replica's journal onto a sibling
+                     (fleet/failover.py).
 """
 
 from __future__ import annotations
@@ -63,7 +69,7 @@ from ..utils.checkpoint import CheckpointKilled, set_io_hook
 
 _KIND_CODE = {"nan": 1, "delay": 2, "sigterm": 3,
               "tick_nan": 4, "tick_delay": 5, "prefill_raise": 6,
-              "journal_kill": 7}
+              "journal_kill": 7, "engine_kill": 8}
 
 
 class Chaos:
@@ -82,7 +88,8 @@ class Chaos:
                  tick_delay_steps: Iterable[int] = (),
                  tick_delay_prob: float = 0.0,
                  prefill_raise_steps: Iterable[int] = (),
-                 journal_kill_step: Optional[int] = None):
+                 journal_kill_step: Optional[int] = None,
+                 engine_kill_step: Optional[int] = None):
         self.seed = int(seed)
         self.delay_s = float(delay_s)
         self._steps = {
@@ -99,12 +106,17 @@ class Chaos:
                 () if journal_kill_step is None
                 else (int(journal_kill_step),)
             ),
+            "engine_kill": frozenset(
+                () if engine_kill_step is None
+                else (int(engine_kill_step),)
+            ),
         }
         self._prob = {"nan": float(nan_prob), "delay": float(delay_prob),
                       "sigterm": 0.0,
                       "tick_nan": float(tick_nan_prob),
                       "tick_delay": float(tick_delay_prob),
-                      "prefill_raise": 0.0, "journal_kill": 0.0}
+                      "prefill_raise": 0.0, "journal_kill": 0.0,
+                      "engine_kill": 0.0}
         self._write_fails_left = int(ckpt_write_failures)
         self._kill_commit = False
         self.injected: List[Dict] = []  # JSON-safe fault log
@@ -240,9 +252,14 @@ class ChaosServingEngine:
     def __getattr__(self, name):
         return getattr(self.engine, name)
 
-    def tick(self) -> int:
+    def tick(self, **kw) -> int:
         t = self.ticks_run
         self.ticks_run += 1
+        if self.chaos.fires("engine_kill", t):
+            from ..fleet.failover import EngineKilled
+            raise EngineKilled(
+                f"chaos: replica killed whole at tick {t}"
+            )
         if self.chaos.fires("tick_delay", t):
             time.sleep(self.chaos.delay_s)
         if self.chaos.fires("tick_nan", t):
@@ -273,7 +290,7 @@ class ChaosServingEngine:
                 )
 
             self.engine.journal.arm_commit_hook(_kill)
-        return self.engine.tick()
+        return self.engine.tick(**kw)
 
     def drain(self, max_ticks: Optional[int] = None) -> int:
         total = 0
@@ -297,15 +314,19 @@ def parse_serving_chaos(spec: str, *, seed: int = 0,
         kind@tick     fire `kind` at that tick       nan@5,delay@7
         kind%prob     seeded per-tick probability    nan%0.02
         journal_kill@tick                            journal_kill@9
+        engine_kill@tick (fleet: kills the whole     engine_kill@12
+        wrapped replica; the router fails it over)
 
     Kinds: nan (slot-poison), delay (tick delay), prefill (prefill
-    raise), journal_kill.  The schedule is deterministic from
-    (spec, seed) — the same A/B replays bit-identically."""
+    raise), journal_kill, engine_kill.  The schedule is deterministic
+    from (spec, seed) — the same A/B replays bit-identically."""
     kinds = {"nan": "tick_nan", "delay": "tick_delay",
-             "prefill": "prefill_raise", "journal_kill": "journal_kill"}
+             "prefill": "prefill_raise", "journal_kill": "journal_kill",
+             "engine_kill": "engine_kill"}
     steps: Dict[str, List[int]] = {k: [] for k in kinds.values()}
     probs: Dict[str, float] = {}
     journal_kill = None
+    engine_kill = None
     for entry in spec.split(","):
         entry = entry.strip()
         if not entry:
@@ -323,11 +344,14 @@ def parse_serving_chaos(spec: str, *, seed: int = 0,
                 f"unknown chaos kind {kind!r} (one of {sorted(kinds)})"
             )
         if sep == "%":
-            if kinds[kind] in ("prefill_raise", "journal_kill"):
+            if kinds[kind] in ("prefill_raise", "journal_kill",
+                               "engine_kill"):
                 raise ValueError(f"{kind} only supports kind@tick")
             probs[kinds[kind]] = float(val)
         elif kinds[kind] == "journal_kill":
             journal_kill = int(val)
+        elif kinds[kind] == "engine_kill":
+            engine_kill = int(val)
         else:
             steps[kinds[kind]].append(int(val))
     return Chaos(
@@ -338,4 +362,5 @@ def parse_serving_chaos(spec: str, *, seed: int = 0,
         tick_delay_prob=probs.get("tick_delay", 0.0),
         prefill_raise_steps=steps["prefill_raise"],
         journal_kill_step=journal_kill,
+        engine_kill_step=engine_kill,
     )

@@ -88,11 +88,28 @@ def run_trace(engine, trace: Sequence[Arrival], *,
     while pending or engine.queue_depth or engine.n_active:
         now = time.monotonic() - t0
         while pending and (not realtime or pending[0].at_s <= now):
-            a = pending.pop(0)
-            requests.append(engine.submit(
-                a.prompt, a.max_new_tokens, deadline_s=a.deadline_s))
             if not realtime:
-                break  # one per spin: admission interleaves with decode
+                # closed-loop feed target: enough queued to fill every
+                # free slot next tick (a one-per-spin feed starves a
+                # multi-slot fleet's occupancy), capped at the engine's
+                # own queue watermark and checked BEFORE submitting —
+                # pushing the queue TO the watermark and then feeding
+                # into it would shed arrivals that the engine could
+                # serve one tick later, turning max-pressure mode into
+                # a shed artifact whenever max_queue < max_active
+                free = engine.config.max_active - engine.n_active
+                target = max(1, free)
+                cap = getattr(engine.config, "max_queue", None)
+                if cap is not None:
+                    target = max(1, min(target, cap))
+                if engine.queue_depth >= target:
+                    break
+            a = pending.pop(0)
+            req = engine.submit(
+                a.prompt, a.max_new_tokens, deadline_s=a.deadline_s)
+            requests.append(req)
+            if not realtime and req.status is not None:
+                break  # watermark shed: the engine is refusing load
         if (realtime and not engine.queue_depth and not engine.n_active
                 and pending):
             # open-loop idle: nothing in flight, next arrival is in the

@@ -120,9 +120,10 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import time
 from collections import deque
-from typing import Deque, List, Optional, Sequence, Union
+from typing import Deque, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -132,7 +133,10 @@ from ..models.gpt2 import resolved_cache_dtype
 from ..models.sampling import sample_logits_at, sample_logits_per_slot
 from .guard import DecodeHealthGuard
 from .journal import RequestJournal, ServingKilled
-from .pool import SCRATCH_BLOCK, PagedKVPool, page_ref
+from .pool import (
+    SCRATCH_BLOCK, BlockPayload, PagedKVPool, export_blocks,
+    import_blocks, page_ref,
+)
 
 # decode-wall samples needed before deadline shedding trusts its price
 # estimate (a cold engine must not shed on compile-time noise)
@@ -257,6 +261,14 @@ class Request:
         self._wait_since: Optional[float] = now
         self._wait_kind = "queue"
         self.last_slot: Optional[int] = None
+        # disaggregated serving (fleet/disagg.py): the priced paged-KV
+        # handoff this request paid — resting-dtype bytes moved between
+        # the prefill and decode pools, and which link class carried
+        # them ("ici" / "dcn", the wire_link_split granule logic).
+        # Zero/None on single-engine paths; serialized on the request
+        # record only when a migration happened.
+        self.kv_migration_bytes = 0
+        self.kv_migration_link: Optional[str] = None
 
     def event(self, name: str, t: float, slot: Optional[int] = None):
         self.events.append((name, t) if slot is None else (name, t, slot))
@@ -293,6 +305,22 @@ class _Slot:
         self.prefill_s = prefill_s
 
 
+@dataclasses.dataclass
+class KVHandoff:
+    """One request in transit between two engines — the disaggregated
+    prefill->decode migration unit (fleet/disagg.py).  `payload` holds
+    the request's pool blocks in the SOURCE pool's resting dtype
+    (quantized pools migrate 1-byte blocks + scales); `pos`/`last` are
+    the slot coordinates the importing engine seats the request at."""
+
+    req: Request
+    payload: BlockPayload
+    pos: int
+    last: int
+    block_tokens: int
+    src_replica: Optional[int] = None
+
+
 class ServingEngine:
     """Continuous-batching inference engine over one model + params.
 
@@ -303,7 +331,8 @@ class ServingEngine:
 
     def __init__(self, model, params, config: ServeConfig = ServeConfig(),
                  *, telemetry=None, logger=None,
-                 journal: Union[None, str, RequestJournal] = None):
+                 journal: Union[None, str, RequestJournal] = None,
+                 replica_id: Optional[int] = None):
         if not getattr(model, "paged_decode_capable", False):
             raise ValueError(
                 f"{type(model).__name__} does not support the paged "
@@ -324,14 +353,21 @@ class ServingEngine:
         self.config = config
         self.telemetry = telemetry
         self.logger = logger
-        self.journal = (RequestJournal(journal)
-                        if isinstance(journal, str) else journal)
+        # fleet identity: stamped on this engine's request/tick records
+        # when set (fleet/router.py, fleet/disagg.py) so one metrics
+        # stream can carry a whole fleet; None keeps single-engine
+        # records byte-compatible with pre-fleet readers
+        self.replica_id = replica_id
+        self._journal: Optional[RequestJournal] = None
         self.max_seq = config.max_seq_tokens or c.block_size
         if not 1 <= self.max_seq <= c.block_size:
             raise ValueError(
                 f"max_seq_tokens={config.max_seq_tokens} must be in "
                 f"[1, block_size={c.block_size}]"
             )
+        # journal attach (property: stamps the serving geometry into the
+        # file) — after max_seq so the stamp reflects the real geometry
+        self.journal = journal
         kv_heads = getattr(c, "kv_heads", c.n_head)
         self._pool_args = dict(
             n_layer=c.n_layer, kv_heads=kv_heads, head_dim=c.head_dim,
@@ -463,6 +499,33 @@ class ServingEngine:
 
     # -- public API ---------------------------------------------------------
 
+    @property
+    def journal(self) -> Optional[RequestJournal]:
+        return self._journal
+
+    @journal.setter
+    def journal(self, j: Union[None, str, RequestJournal]) -> None:
+        """Attach a request journal (path or instance) and stamp THIS
+        engine's serving geometry into it — `recover()` validates that
+        stamp against the recovering engine up front, so a journal
+        replayed onto a mismatched sibling fails with both geometries
+        named instead of deep inside pool scatter."""
+        self._journal = RequestJournal(j) if isinstance(j, str) else j
+        if self._journal is not None:
+            self._journal.geometry(self._geometry())
+
+    def _geometry(self) -> Dict[str, int]:
+        """The compiled serving shapes replay-exactness depends on:
+        a sibling engine must share ALL of these for a journal replay
+        to re-prefill and continue token-exact."""
+        c = self.model.config
+        return dict(
+            block_size=int(c.block_size),
+            max_seq_tokens=int(self.max_seq),
+            vocab=int(c.vocab_size),
+            block_tokens=int(self.config.block_tokens),
+        )
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                deadline_s: Optional[float] = None,
                seed: Optional[int] = None) -> Request:
@@ -514,11 +577,17 @@ class ServingEngine:
         self._queue.append(req)
         return req
 
-    def tick(self) -> int:
+    def tick(self, *, decode: bool = True) -> int:
         """One scheduler step: enforce deadlines -> admit ->
         grow/preempt -> one decode step for every active slot ->
         quarantine/evict.  Returns the number of tokens produced
         (prefill first-tokens included).
+
+        `decode=False` stops after admission — the PREFILL half of a
+        disaggregated pair (fleet/disagg.py): prompts prefill into this
+        engine's pool and first tokens sample, but no decode step runs;
+        the admitted slots park until `export_request` hands them to a
+        decode engine.
 
         Any exception out of the tick body (a poisoned pool view, a
         chaos-injected prefill failure) trips the watchdog warm restart
@@ -532,7 +601,7 @@ class ServingEngine:
                      "draft_s": 0.0}
         self._tick_counts = dict.fromkeys(self._tick_counts, 0)
         try:
-            produced = self._tick_body()
+            produced = self._tick_body(decode=decode)
         except ServingKilled:
             raise
         except Exception as e:
@@ -564,7 +633,9 @@ class ServingEngine:
                 )
         return total
 
-    def recover(self, journal: Union[None, str] = None) -> List[Request]:
+    def recover(self, journal: Union[None, str] = None, *,
+                adopt: Optional[Dict[int, Request]] = None
+                ) -> List[Request]:
         """Re-queue a crashed engine's in-flight requests from its
         journal, FRONT of the queue in their original admission order,
         each with the token prefix the journal had committed — they
@@ -577,7 +648,22 @@ class ServingEngine:
         from the uninterrupted run).  Returns the
         re-queued handles.  Call on a FRESH engine built with the same
         model/params/config as the dead one (exactness needs the same
-        programs); latency marks restart at recovery time."""
+        programs); latency marks restart at recovery time.
+
+        The journal's geometry stamp is validated against THIS engine
+        up front — replay is only exact onto the same compiled shapes,
+        and failover (fleet/failover.py) made the mismatched-sibling
+        path load-bearing: without the check it fails deep inside pool
+        scatter with no hint which side is wrong.
+
+        `adopt` maps request id -> an EXISTING Request handle to reuse
+        (fleet failover: the dead replica's callers keep their handles
+        — the sibling resets each to its committed prefix and continues
+        it, so `submit`-returned objects survive engine loss).  When
+        this engine journals to a DIFFERENT file than `journal`, every
+        recovered request is re-journaled here (submit + committed
+        prefix): the sibling's own journal stays self-contained for a
+        second failure."""
         path = journal
         if path is None:
             if self.journal is None:
@@ -586,21 +672,65 @@ class ServingEngine:
                     "constructed with journal=)"
                 )
             path = self.journal.path
+        geom = RequestJournal.read_geometry(path)
+        if geom is not None:
+            mine = self._geometry()
+            bad = {k: (geom[k], mine[k]) for k in mine
+                   if k in geom and geom[k] != mine[k]}
+            if bad:
+                raise ValueError(
+                    "journal/engine geometry mismatch — replaying "
+                    f"{path} onto this engine would fail inside pool "
+                    "scatter (replay is only exact onto the same "
+                    "compiled shapes): " + ", ".join(
+                        f"{k}: journal={j} vs engine={e}"
+                        for k, (j, e) in sorted(bad.items()))
+                )
+        # re-journal into a DIFFERENT journal than the one replayed:
+        # the failover path, where the sibling's WAL must become
+        # self-contained for the requests it adopts
+        cross = (self.journal is not None
+                 and os.path.abspath(self.journal.path)
+                 != os.path.abspath(path))
         interrupted, done_ids = RequestJournal.replay(path)
         out: List[Request] = []
         max_seen = max(
             [e["id"] for e in interrupted] + done_ids, default=-1)
         for e in interrupted:
-            req = Request(e["prompt"], e["max_new"],
-                          deadline_s=e["deadline_s"], seed=e["seed"],
-                          id=e["id"])
-            req.tokens = list(e["tokens"])
+            req = adopt.get(e["id"]) if adopt else None
+            if req is not None:
+                # the caller's live handle: reset to the journal's
+                # committed prefix (tokens past the last commit died
+                # with the engine; re-decoding reproduces them exactly)
+                # and keep its lifecycle/attribution history — the
+                # abandon() that closed the dead engine already opened
+                # the restart-overhead wait window
+                now = time.monotonic()
+                req.tokens = list(e["tokens"])
+                # per-token latency entries past the committed prefix
+                # belong to tokens that died with the engine — the
+                # re-decode appends fresh ones
+                req.token_lat = req.token_lat[:len(req.tokens)]
+                req.state = "queued"
+                req.status = None
+                req.finish_reason = None
+                if req._wait_since is None:
+                    req._wait_since, req._wait_kind = now, "restart"
+                req.event("recovered", now)
+            else:
+                req = Request(e["prompt"], e["max_new"],
+                              deadline_s=e["deadline_s"], seed=e["seed"],
+                              id=e["id"])
+                req.tokens = list(e["tokens"])
+                # the wait from recovery to re-admission is restart
+                # overhead, not queue wait: the crash-restart cycle (not
+                # arrival pressure) is what the request is paying for
+                req._wait_kind = "restart"
+                req.event("recovered", req.t_arrival)
+            if cross:
+                self.journal.submit(req)
+                self.journal.tokens(req.id, req.tokens)
             req._journaled = self.journal is not None
-            # the wait from recovery to re-admission is restart
-            # overhead, not queue wait: the crash-restart cycle (not
-            # arrival pressure) is what the request is paying for
-            req._wait_kind = "restart"
-            req.event("recovered", req.t_arrival)
             if self._finished(req):
                 # finished before the crash (length OR eos) — only its
                 # end line was lost; close it out, never re-queue
@@ -622,6 +752,121 @@ class ServingEngine:
             self._flight.flush(self.logger, "serve_recover",
                                at_step=self._ticks)
         return out
+
+    # -- disaggregation hooks (fleet/disagg.py) -----------------------------
+
+    def export_request(self, i: int) -> KVHandoff:
+        """Pop active slot `i` and hand its request off WITH its paged
+        K/V block contents — the source half of a disaggregated
+        prefill->decode migration.  The payload leaves in the pool's
+        resting dtype (a quantized pool migrates 1-byte blocks +
+        scales, the same 4x compression it rests at); the slot's blocks
+        return to this engine's free list immediately (the gather
+        materialized fresh arrays).  The request re-opens a wait window
+        — billed to queue-wait — until the importing engine seats it."""
+        slot = self._slots[i]
+        if slot is None:
+            raise ValueError(f"slot {i} is empty — nothing to export")
+        req = slot.req
+        now = time.monotonic()
+        payload = export_blocks(self.pool.view, slot.table)
+        self.pool.free_blocks(slot.table)
+        self._slots[i] = None
+        self._close_active(req, slot, now)
+        req.state = "queued"
+        req._wait_since, req._wait_kind = now, "queue"
+        req.event("exported", now, i)
+        return KVHandoff(req=req, payload=payload, pos=slot.pos,
+                         last=slot.last,
+                         block_tokens=self.config.block_tokens,
+                         src_replica=self.replica_id)
+
+    def can_import(self, n_blocks: int) -> bool:
+        """Whether `import_request` of an `n_blocks` payload would seat
+        right now — a free decode slot and enough free pool blocks.
+        The disagg coordinator checks BEFORE exporting so a handoff is
+        never left in limbo between two engines."""
+        return (None in self._slots
+                and self.pool.blocks_free >= n_blocks)
+
+    def import_request(self, handoff: KVHandoff) -> bool:
+        """Seat an exported request — the destination half of the
+        migration: allocate blocks, scatter the payload into them, and
+        occupy a decode slot at the handoff's (pos, last) coordinates,
+        WITHOUT re-running prefill (the K/V moved instead).  Returns
+        False (nothing consumed) when no slot or blocks are free;
+        geometry/dtype mismatches between the pools raise with both
+        sides named (serving/pool.import_blocks)."""
+        if self._spec is not None:
+            raise ValueError(
+                "import_request on a speculative engine is unsupported "
+                "— drafter state only rebuilds through the prefill "
+                "admission path"
+            )
+        if handoff.block_tokens != self.config.block_tokens:
+            raise ValueError(
+                f"paged-KV migration geometry mismatch: payload blocks "
+                f"hold {handoff.block_tokens} tokens but this engine's "
+                f"hold {self.config.block_tokens}"
+            )
+        n = int(handoff.payload.k.shape[0])
+        if n > self.max_blocks_per_req:
+            raise ValueError(
+                f"{n}-block payload exceeds this engine's "
+                f"{self.max_blocks_per_req}-block table width "
+                f"(max_seq_tokens={self.max_seq}) — source and "
+                "destination engines must share max_seq_tokens"
+            )
+        try:
+            slot_i = self._slots.index(None)
+        except ValueError:
+            return False
+        ids = self.pool.alloc(n)
+        if ids is None:
+            return False
+        self.pool.view = import_blocks(self.pool.view, ids,
+                                       handoff.payload)
+        req = handoff.req
+        now = time.monotonic()
+        if req._wait_since is not None:
+            req.lat_components[req._wait_kind] += now - req._wait_since
+            req._wait_since = None
+        if req.t_admitted is None:
+            req.t_admitted = now
+        req.event("imported", now, slot_i)
+        req.last_slot = slot_i
+        req.state = "active"
+        self._slots[slot_i] = _Slot(req, table=ids, pos=handoff.pos,
+                                    last_token=handoff.last,
+                                    admitted_at=now, prefill_s=0.0)
+        self._count("serve_admissions")
+        return True
+
+    # -- fleet failover hooks (fleet/failover.py) ---------------------------
+
+    def abandon(self) -> None:
+        """Mark this engine DEAD after a fatal fault: close every active
+        request's window (billed to restart-overhead — the engine, not
+        the scheduler, took the slot away), clear the queue (the journal
+        is the durable copy a sibling replays), and close the journal
+        WITHOUT committing its buffer — an in-process death must look on
+        disk exactly like a SIGKILL between append and fsync.  The pool
+        is left as-is: it died with the engine."""
+        now = time.monotonic()
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            s.req.state = "queued"
+            self._close_active(s.req, s, now)
+            s.req._wait_since, s.req._wait_kind = now, "restart"
+            s.req.event("engine_lost", now, i)
+        self._slots = [None] * self.config.max_active
+        for req in self._queue:
+            req.event("engine_lost", now)
+        self._queue.clear()
+        self._poison_pending.clear()
+        if self._journal is not None:
+            self._journal.abandon()
 
     @property
     def n_active(self) -> int:
@@ -679,7 +924,7 @@ class ServingEngine:
 
     # -- scheduler internals ------------------------------------------------
 
-    def _tick_body(self) -> int:
+    def _tick_body(self, decode: bool = True) -> int:
         self._enforce_deadlines(time.monotonic())
         # growth first: existing slots claim the blocks their next write
         # needs BEFORE admission can take them — the other order lets a
@@ -690,7 +935,7 @@ class ServingEngine:
         produced = self._admit()
         active = [(i, s) for i, s in enumerate(self._slots)
                   if s is not None]
-        if active:
+        if active and decode:
             if self._spec is not None:
                 produced += self._decode_spec(active)
             else:
@@ -1222,6 +1467,13 @@ class ServingEngine:
             )
             if req.last_slot is not None:
                 rec["slot"] = req.last_slot
+            if self.replica_id is not None:
+                rec["replica_id"] = self.replica_id
+            if req.kv_migration_bytes:
+                # disaggregated handoff pricing: measured payload bytes
+                # + which link class carried them (fleet/disagg.py)
+                rec["kv_migration_bytes"] = int(req.kv_migration_bytes)
+                rec["kv_migration_link"] = req.kv_migration_link or "ici"
             if self._spec is not None:
                 # per-request speculation yield: drafts proposed for /
                 # accepted into this sequence (accept rate = ratio)
@@ -1361,10 +1613,12 @@ class ServingEngine:
         every = self.config.tick_record_every
         sampled = bool(every) and tick_i % every == 0
         if eventful or sampled:
+            extra = ({} if self.replica_id is None
+                     else {"replica_id": self.replica_id})
             self.logger.log_meta(
                 kind="tick", tick=tick_i,
                 t_s=round(t0, 6), wall_s=round(wall, 6),
-                **segments, **state, **counts,
+                **segments, **state, **counts, **extra,
                 emit="event" if eventful else "sample",
             )
         if self._flight_reason is not None:

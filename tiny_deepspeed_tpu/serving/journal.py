@@ -24,10 +24,20 @@ Write discipline:
 
 Event lines:
 
+    {"ev": "geom", "block_size": 64, "max_seq_tokens": 40,
+     "vocab": 128, "block_tokens": 8}
     {"ev": "submit", "id": 3, "prompt": [...], "max_new": 16,
      "deadline_s": null, "seed": 3}
     {"ev": "tok", "id": 3, "toks": [41, 7]}
     {"ev": "end", "id": 3, "status": "ok", "finish": "length"}
+
+The `geom` line is the engine's serving geometry, stamped when the
+journal attaches: replay is only exact onto an engine with the same
+compiled shapes, and `ServingEngine.recover()` validates the journal's
+geometry against its own UP FRONT (naming both sides) instead of
+failing deep inside pool scatter — the check failover made load-bearing
+(a journal replayed onto an arbitrary sibling, not the engine that
+wrote it).
 
 `replay()` folds a journal back into (pending requests in admission
 order, finished ids): a request with an "end" line is done; everything
@@ -57,6 +67,14 @@ class RequestJournal:
         self.path = str(path)
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
+        # repair-on-open: a crash can tear the previous writer's FINAL
+        # line (partial write, no newline).  Appending after it would
+        # glue the next line onto the fragment — one merged unparseable
+        # line that is no longer the tail, which `replay` rightly calls
+        # corruption.  The fragment carries nothing replay would keep
+        # (torn tails are skipped), so truncate it before appending —
+        # the standard WAL open-repair.
+        self._repair_torn_tail()
         # append mode: recovery continues the SAME file, so a second
         # crash replays both segments
         self._fh = open(self.path, "a")
@@ -64,6 +82,23 @@ class RequestJournal:
         # test hook: called in commit() after lines are handed to the
         # buffer but before they reach the file — where a kill hurts most
         self._commit_hook = None
+
+    def _repair_torn_tail(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        size = os.path.getsize(self.path)
+        if size == 0:
+            return
+        with open(self.path, "rb+") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) == b"\n":
+                return
+            # walk back to the last newline; everything after it is the
+            # torn fragment
+            f.seek(0)
+            data = f.read()
+            cut = data.rfind(b"\n") + 1  # 0 when no newline at all
+            f.truncate(cut)
 
     # -- append (buffered; atomic single-write lines) -----------------------
 
@@ -85,6 +120,15 @@ class RequestJournal:
     def end(self, req_id: int, status: str, finish: str) -> None:
         self._append({"ev": "end", "id": req_id, "status": status,
                       "finish": finish})
+
+    def geometry(self, geom: Dict) -> None:
+        """Stamp the writing engine's serving geometry (committed
+        immediately — the line must exist before any crash could need
+        it).  Appended once per attaching engine; `read_geometry` reads
+        the FIRST stamp, i.e. the geometry the journaled requests were
+        actually served under."""
+        self._append({"ev": "geom", **geom})
+        self.commit()
 
     def commit(self) -> None:
         """Write every buffered line (one write() per line), flush, and
@@ -114,7 +158,38 @@ class RequestJournal:
             self._fh.close()
             self._fh = None
 
+    def abandon(self) -> None:
+        """Drop the uncommitted buffer and close the file WITHOUT
+        committing — the in-process stand-in for the writing engine's
+        death (fleet failover: the dead replica's buffered tick is lost
+        exactly as a SIGKILL between append and fsync would lose it;
+        recovery re-decodes those tokens to the same values)."""
+        self._buf = []
+        self._commit_hook = None
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
     # -- replay -------------------------------------------------------------
+
+    @staticmethod
+    def read_geometry(path: str) -> Optional[Dict]:
+        """The FIRST `geom` line's fields (the writing engine's serving
+        geometry), or None for a journal that predates the stamp.
+        Torn/garbage lines are skipped — geometry reading must never be
+        stricter than `replay`, which tolerates a torn tail."""
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("ev") == "geom":
+                    return {k: v for k, v in rec.items() if k != "ev"}
+        return None
 
     @staticmethod
     def replay(path: str) -> Tuple[List[Dict], List[int]]:

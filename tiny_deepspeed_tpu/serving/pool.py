@@ -197,6 +197,90 @@ def paged_append_span(view: KVPoolView, ks, vs, tables, pos0, count,
     )
 
 
+class BlockPayload(NamedTuple):
+    """The CONTENTS of a set of pool blocks in transit between two
+    engines' pools — the disaggregated prefill->decode migration unit
+    (fleet/disagg.py).  Arrays keep the pool's RESTING dtype: a
+    quantized pool hands off 1-byte blocks plus their f32 scales, so
+    migrated bytes get the same 4x compression as pool bytes.  k/v:
+    (n_blocks, block_tokens, L, KVH, Dh); scales (n_blocks, block_tokens,
+    L, KVH) or None on the unquantized path."""
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: Optional[jax.Array]
+    v_scale: Optional[jax.Array]
+
+
+def export_blocks(view: KVPoolView, ids: List[int]) -> BlockPayload:
+    """Gather physical blocks `ids` out of the pool, contents only —
+    the source side of a paged-KV migration.  The gather materializes
+    fresh arrays, so the caller may free (and the pool reuse) the
+    source blocks immediately after."""
+    idx = jnp.asarray(list(ids), jnp.int32)
+
+    def sel(a):
+        return None if a is None else a[idx]
+
+    return BlockPayload(sel(view.k), sel(view.v),
+                        sel(view.k_scale), sel(view.v_scale))
+
+
+def import_blocks(view: KVPoolView, ids: List[int],
+                  payload: BlockPayload) -> KVPoolView:
+    """Scatter a migrated payload into freshly allocated blocks `ids`
+    of THIS pool — the destination side of a paged-KV migration.  The
+    two pools must agree on resting dtype, quantization mode, and block
+    geometry; a mismatch is refused up front naming both sides (the
+    alternative is garbage K/V read through the decode panel)."""
+    if payload.k.dtype != view.k.dtype:
+        raise ValueError(
+            f"paged-KV migration dtype mismatch: payload rests at "
+            f"{jnp.dtype(payload.k.dtype)} but this pool at "
+            f"{jnp.dtype(view.k.dtype)} — source and destination pools "
+            "must share the same `quant` / cache dtype"
+        )
+    if (payload.k_scale is None) != (view.k_scale is None):
+        raise ValueError(
+            "paged-KV migration quantization mismatch: payload is "
+            f"{'un' if payload.k_scale is None else ''}scaled but this "
+            f"pool is {'un' if view.k_scale is None else ''}scaled"
+        )
+    if tuple(payload.k.shape[1:]) != tuple(view.k.shape[1:]):
+        raise ValueError(
+            f"paged-KV migration geometry mismatch: payload blocks are "
+            f"{tuple(payload.k.shape[1:])} (block_tokens, L, KVH, Dh) "
+            f"but this pool's are {tuple(view.k.shape[1:])}"
+        )
+    if len(ids) != payload.k.shape[0]:
+        raise ValueError(
+            f"{len(ids)} destination blocks for a "
+            f"{payload.k.shape[0]}-block payload"
+        )
+    idx = jnp.asarray(list(ids), jnp.int32)
+    new = view._replace(
+        k=view.k.at[idx].set(payload.k),
+        v=view.v.at[idx].set(payload.v),
+    )
+    if view.k_scale is not None:
+        new = new._replace(
+            k_scale=view.k_scale.at[idx].set(payload.k_scale),
+            v_scale=view.v_scale.at[idx].set(payload.v_scale),
+        )
+    return new
+
+
+def payload_bytes(payload: BlockPayload) -> int:
+    """The migration's wire footprint: what actually moves between the
+    pools (resting-dtype blocks + scales — NOT the dequantized f32
+    size), summed from the arrays' own dtypes/shapes so the priced
+    number is measured, not modeled."""
+    return int(sum(
+        a.size * jnp.dtype(a.dtype).itemsize
+        for a in payload if a is not None
+    ))
+
+
 def paged_scatter(view: KVPoolView, ks, vs, block_ids,
                   block_tokens: int) -> KVPoolView:
     """Scatter a prefill's full-prompt K/V — ks/vs (L, 1, KVH, P, Dh)

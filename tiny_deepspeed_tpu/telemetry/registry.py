@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 from typing import Dict, Optional
 
 import numpy as np
@@ -38,14 +39,21 @@ _GB = float(2 ** 30)
 
 
 class Counter:
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0
+        # inc() is a read-modify-write: fleet replicas ticking on a
+        # thread pool (fleet/router.py parallel=True) share one
+        # registry, and unsynchronized increments LOSE counts — in a
+        # repo whose telemetry exists to be exact.  One short-lived
+        # lock per counter; the single-threaded paths pay nanoseconds.
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> int:
-        self.value += n
-        return self.value
+        with self._lock:
+            self.value += n
+            return self.value
 
 
 class Histogram:

@@ -48,14 +48,23 @@ _NUM = (int, float)
 #      (lat_s / comp_*_s), run_meta may carry the `serve` config dict
 #      (what the trace viewer needs to lay out slot tracks), and the
 #      dcn_wire_bytes gauge (per-link ICI-vs-DCN ledger split)
-#   7: + speculative decoding (this PR): tick records carry the drafter
+#   7: + speculative decoding: tick records carry the drafter
 #      wall `draft_s` (the draft-vs-verify split; decode_s/fetch_s are
 #      the verify side), request records carry spec_proposed /
 #      spec_accepted (per-request draft yield), and the
 #      serve_spec_accept_rate / serve_spec_tokens_per_tick gauges —
 #      all emitted ONLY by spec-enabled engines, so spec-off files are
 #      byte-compatible with v6 readers
-SCHEMA_VERSION = 7
+#   8: + fleet serving (this PR): request / tick / fault records carry
+#      `replica_id` when the writing engine has one (a whole fleet
+#      shares one metrics stream), request records of disaggregated
+#      runs carry kv_migration_bytes / kv_migration_link (the priced
+#      prefill->decode paged-KV handoff: measured payload bytes and the
+#      wire_link_split granule classification "ici"/"dcn"), and the
+#      fleet_dispatch / fleet_failover / fleet_replicas_live router
+#      gauges — all emitted only by fleet/disagg runs, so single-engine
+#      files stay byte-compatible with v7 readers
+SCHEMA_VERSION = 8
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -218,6 +227,17 @@ META_FIELDS: Dict[str, tuple] = {
     # committed sequence itself is target-exact either way)
     "spec_proposed": int,
     "spec_accepted": int,
+    # fleet serving (schema v8): which engine replica wrote this
+    # request/tick/fault record — one metrics stream carries a whole
+    # fleet, and serve_report.py's Fleet section groups by it
+    "replica_id": int,
+    # disaggregated serving (schema v8): the prefill->decode paged-KV
+    # handoff this request paid — MEASURED payload bytes (pool resting
+    # dtype + scales, so quantized pools show the same 4x compression
+    # they rest at) and the link class the transfer crossed ("ici" /
+    # "dcn", classified by wire_link_split's granule logic)
+    "kv_migration_bytes": int,
+    "kv_migration_link": str,
     # tick record (serving scheduler; schema v6).  t_s is the tick-start
     # stamp on the same monotonic clock as request `events`; wall_s the
     # full tick wall; sched_s/prefill_s/decode_s/fetch_s partition it
@@ -417,4 +437,12 @@ GAUGES: Dict[str, str] = {
                                   "engine lifetime — the realized "
                                   "multi-token yield vs the plain "
                                   "path's fixed 1.0",
+    "fleet_dispatch": "requests dispatched by the fleet router to any "
+                      "replica, cumulative (fleet/router.py) — door "
+                      "sheds excluded: those never reach a queue",
+    "fleet_failover": "replica deaths failed over by the router "
+                      "(journal replayed onto a sibling), cumulative",
+    "fleet_replicas_live": "live replicas behind the router at the "
+                           "last dispatch/tick — the fleet's serving "
+                           "capacity denominator",
 }
