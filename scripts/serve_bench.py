@@ -114,6 +114,30 @@ def main(argv=None) -> int:
     p.add_argument("--spec-k", type=int, default=4,
                    help="draft span width: up to this many tokens "
                         "proposed+verified per slot per tick")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="shared-prefix KV reuse: radix tree over the "
+                        "refcounted pool — matched prompt blocks alias "
+                        "copy-on-write, only the suffix prefills "
+                        "(serving/prefix.py)")
+    p.add_argument("--prefix-pool", type=int, default=0, metavar="P",
+                   help="shared-prefix TRACE: draw each prompt's "
+                        "leading --prefix-len tokens from P distinct "
+                        "system prompts, Zipf-weighted (0 = plain "
+                        "uniform trace)")
+    p.add_argument("--prefix-len", type=int, default=32,
+                   help="system-prompt length for --prefix-pool traces")
+    p.add_argument("--zipf-a", type=float, default=1.2,
+                   help="Zipf exponent over the --prefix-pool prompts")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="multi-tenant mode: comma list of "
+                        "name[:weight[:tokens_per_tick[:max_queue]]] "
+                        "policies (serving/tenancy.py); arrivals are "
+                        "tagged by weight-proportional draw and "
+                        "admission turns weighted-fair")
+    p.add_argument("--tenant-weights", default=None, metavar="W",
+                   help="override the ARRIVAL mix only: comma weights "
+                        "aligned with --tenants order (default: the "
+                        "tenants' scheduling weights)")
     p.add_argument("--serial", action="store_true",
                    help="also run the one-at-a-time generate() baseline "
                         "on the same trace and report the ratio")
@@ -146,12 +170,50 @@ def main(argv=None) -> int:
     cfg = model.config
     params = model.init(jax.random.PRNGKey(args.seed))
     prompt_lens = [int(x) for x in args.prompt_lens.split(",")]
-    trace = poisson_trace(
-        args.requests, rate_rps=args.rate,
-        prompt_lens=prompt_lens,
-        max_new_tokens=args.max_new_tokens, vocab_size=cfg.vocab_size,
-        seed=args.seed, deadline_s=args.deadline,
-    )
+
+    tenants = None
+    tenant_mix = None
+    if args.tenants:
+        from tiny_deepspeed_tpu.serving import parse_tenant_spec
+        tenants = parse_tenant_spec(args.tenants)
+        tenant_mix = {n: pol.weight for n, pol in tenants.items()}
+        if args.tenant_weights:
+            ws = [float(x) for x in args.tenant_weights.split(",")]
+            names = [e.split(":")[0] for e in args.tenants.split(",")
+                     if e.strip()]
+            if len(ws) != len(names):
+                p.error("--tenant-weights must match --tenants count")
+            tenant_mix = dict(zip(names, ws))
+
+    if args.prefix_pool:
+        from tiny_deepspeed_tpu.serving.driver import shared_prefix_trace
+        suffix_lens = [max(1, pl - args.prefix_len)
+                       for pl in prompt_lens]
+        trace = shared_prefix_trace(
+            args.requests, rate_rps=args.rate,
+            prefix_pool=args.prefix_pool, prefix_len=args.prefix_len,
+            suffix_lens=suffix_lens, zipf_a=args.zipf_a,
+            max_new_tokens=args.max_new_tokens,
+            vocab_size=cfg.vocab_size, seed=args.seed,
+            deadline_s=args.deadline, tenants=tenant_mix,
+        )
+        prompt_lens = sorted({args.prefix_len + s for s in suffix_lens})
+    else:
+        trace = poisson_trace(
+            args.requests, rate_rps=args.rate,
+            prompt_lens=prompt_lens,
+            max_new_tokens=args.max_new_tokens,
+            vocab_size=cfg.vocab_size,
+            seed=args.seed, deadline_s=args.deadline,
+        )
+        if tenant_mix:
+            import numpy as _np
+            trng = _np.random.default_rng(args.seed + 1)
+            names = sorted(tenant_mix)
+            tw = _np.asarray([tenant_mix[n] for n in names])
+            tw = tw / tw.sum()
+            trace = [a._replace(tenant=str(trng.choice(names, p=tw)))
+                     for a in trace]
 
     tel = Telemetry()
 
@@ -168,6 +230,7 @@ def main(argv=None) -> int:
         seed=args.seed, max_seq_tokens=max_seq,
         max_queue=args.max_queue, shed_pool_util=args.shed_pool_util,
         spec_draft=args.spec_draft, spec_k=args.spec_k,
+        prefix_cache=args.prefix_cache, tenants=tenants,
     )
     realtime = not args.closed_loop and args.rate is not None
 
@@ -194,6 +257,12 @@ def main(argv=None) -> int:
                         spec_k=args.spec_k,
                         replicas=args.replicas,
                         disagg=bool(args.disagg),
+                        prefix_cache=bool(args.prefix_cache),
+                        tenants={n: {"weight": pol.weight,
+                                     "tokens_per_tick":
+                                         pol.tokens_per_tick,
+                                     "max_queue": pol.max_queue}
+                                 for n, pol in (tenants or {}).items()},
                     ))
         return lg
 
@@ -211,6 +280,10 @@ def main(argv=None) -> int:
         p.error("--disagg does not compose with --spec-draft (drafter "
                 "state only rebuilds through the prefill admission "
                 "path)")
+    if args.prefix_cache and args.spec_draft:
+        p.error("--prefix-cache does not compose with --spec-draft "
+                "(the suffix prefill and the draft span both own the "
+                "span program)")
     if (args.chaos and "journal_kill" in args.chaos
             and not args.journal and args.replicas == 1):
         p.error("--chaos journal_kill@N needs --journal PATH (the kill "
@@ -233,11 +306,21 @@ def main(argv=None) -> int:
         Arrival(0.0, [0] * plen, min(2, args.max_new_tokens))
         for plen in sorted(set(prompt_lens))
     ]
+    if args.prefix_cache:
+        # a SECOND identical-prompt request per length hits the tree
+        # and compiles the suffix-prefill bucket — without it the
+        # measured pass pays that XLA compile on its first cache hit
+        warm_trace = [a for a in warm_trace for _ in range(2)]
 
     def warmed_engine(journal_path=None, replica_id=None):
         e = ServingEngine(model, params, serve_cfg,
                           replica_id=replica_id)
         run_trace(e, warm_trace, realtime=False)
+        if e._prefix is not None:
+            # warm requests compiled the suffix program (and may sit
+            # warm in the tree), but the measured pass's hit-rate
+            # stats must price the TRACE only
+            e._prefix.reset_stats()
         if journal_path:
             e.journal = RequestJournal(journal_path)
         return e
@@ -340,6 +423,10 @@ def main(argv=None) -> int:
         }
     if args.disagg:
         summary["disagg"] = eng.migration_summary()
+    if "prefix_cache" in res:
+        summary["prefix_cache"] = res["prefix_cache"]
+    if "tenants" in res:
+        summary["tenants"] = res["tenants"]
 
     if args.chaos:
         # goodput under faults, A/B on the SAME trace: the clean pass
@@ -451,6 +538,23 @@ def main(argv=None) -> int:
         print(f"speculation [{sp['drafter']} k={sp['k']}]: "
               f"accept rate {sp['accept_rate']} "
               f"({sp['accepted']}/{sp['proposed']} drafts)")
+    if "prefix_cache" in summary:
+        pc = summary["prefix_cache"]
+        print(f"prefix cache: hit rate {pc['hit_rate']} "
+              f"({pc['blocks_aliased']} blocks aliased, "
+              f"{pc['prefill_tokens_avoided']} prefill tokens avoided, "
+              f"{pc['cached_blocks']} warm, "
+              f"{pc['tree_evictions']} tree evictions)")
+    if "tenants" in summary:
+        for name, td in sorted(summary["tenants"].items()):
+            sc_t = td["status_counts"]
+            bu = td.get("scheduler", {}).get("budget_utilization")
+            print(f"tenant {name}: {td['requests']} req "
+                  f"(ok {sc_t['ok']} / shed {sc_t['shed']} / expired "
+                  f"{sc_t['expired']}), goodput "
+                  f"{td['ok_tokens_per_s']} tok/s, p99 TTFT "
+                  f"{td['ttft']['p99_ms']}ms"
+                  + (f", budget util {bu}" if bu is not None else ""))
     if args.chaos:
         ch = summary["chaos"]
         if ch.get("journal_killed"):

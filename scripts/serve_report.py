@@ -32,6 +32,13 @@ operational questions the percentile headline cannot:
     goodput/p99 breakdown keyed on `replica_id`, the router's failover
     fault records, and the disaggregated prefill->decode KV-migration
     totals (measured bytes, by ICI/DCN link class).
+  * tenancy table (schema v9, tenant-tagged runs): per-tenant goodput,
+    p99 TTFT/latency, shed-by-reason, and budget utilization (from the
+    run_meta tenant policies x tick count).
+  * prefix-cache section (schema v9, prefix-cache runs): blocks
+    aliased, prefill tokens avoided / hit rate from the request
+    records, and the refcount-measured pool bytes saved from the
+    telemetry summary gauges.
 
 Exit codes: 0 ok; 1 parse errors in the JSONL (partial report rendered);
 2 missing/empty input or no serving records at all.
@@ -219,6 +226,107 @@ def render_serve_report(metas: List[dict], source: str = "") -> str:
                        f"{verify:.3f} s "
                        f"({draft / max(draft + verify, 1e-9):.0%} of "
                        "decode time spent drafting)")
+        out.append("")
+
+    # -- tenancy ------------------------------------------------------------
+    by_tenant: Dict[str, List[dict]] = {}
+    for r in reqs:
+        if isinstance(r.get("tenant"), str):
+            by_tenant.setdefault(r["tenant"], []).append(r)
+    if by_tenant:
+        run_tenants = (run.get("serve") or {}).get("tenants") or {}
+        # tick records are SAMPLED — the highest tick INDEX (+1) is the
+        # real tick count the budget accrued over, not the record count
+        tick_idx = [t["tick"] for t in ticks
+                    if isinstance(t.get("tick"), int)]
+        n_ticks = max(tick_idx) + 1 if tick_idx else None
+        out.append("## Tenancy\n")
+        out.append("| tenant | requests | ok | goodput tokens | "
+                   "p99 TTFT | p99 latency | shed by reason | "
+                   "budget util (est.) |")
+        out.append("|---|---|---|---|---|---|---|---|")
+        for name in sorted(by_tenant):
+            rs = by_tenant[name]
+            oks = [r for r in rs if r.get("status") == "ok"]
+            ttfts = [r["ttft_s"] for r in rs
+                     if isinstance(r.get("ttft_s"), (int, float))]
+            lats = [r["lat_s"] for r in rs
+                    if isinstance(r.get("lat_s"), (int, float))
+                    and r.get("status") != "shed"]
+            sheds: Dict[str, int] = {}
+            for r in rs:
+                fin = str(r.get("finish", ""))
+                if r.get("status") == "shed" and fin.startswith("shed:"):
+                    key = fin.split(":", 1)[1]
+                    sheds[key] = sheds.get(key, 0) + 1
+            shed_s = ", ".join(f"{k} {v}"
+                               for k, v in sorted(sheds.items())) or "-"
+            # budget utilization: admitted token cost over the budget
+            # the run's tick count granted (run_meta carries the
+            # policy; only computable when a budget is configured)
+            pol = run_tenants.get(name) or {}
+            rate = pol.get("tokens_per_tick")
+            util = "-"
+            if rate and n_ticks:
+                admitted = sum(
+                    r.get("prompt_tokens", 0) + r.get("new_tokens", 0)
+                    for r in rs if r.get("status") != "shed")
+                util = f"{admitted / (rate * n_ticks):.0%}"
+            out.append(
+                f"| {name} | {len(rs)} | {len(oks)} | "
+                f"{sum(r.get('new_tokens', 0) for r in oks)} | "
+                f"{_ms(_quantile(ttfts, 0.99)) if ttfts else '-'} | "
+                f"{_ms(_quantile(lats, 0.99)) if lats else '-'} | "
+                f"{shed_s} | {util} |")
+        out.append("")
+        if any((run_tenants.get(n) or {}).get("tokens_per_tick")
+               for n in by_tenant):
+            out.append(
+                "Budget util here is an ESTIMATE from delivered "
+                "tokens over rate x ticks — the scheduler's measured "
+                "number (admission cost = prompt + max_new per "
+                "admission, resumes included) is the "
+                "`budget_utilization` in the bench JSON's per-tenant "
+                "scheduler stats.\n")
+
+    # -- prefix cache -------------------------------------------------------
+    pc_reqs = [r for r in reqs
+               if isinstance(r.get("prefix_blocks"), int)]
+    gauges = {}
+    for m in metas:
+        if m.get("kind") == "telemetry_summary" \
+                and isinstance(m.get("gauges"), dict):
+            gauges.update(m["gauges"])
+    if pc_reqs or any(k.startswith("serve_prefix_") for k in gauges):
+        aliased = sum(r.get("prefix_blocks", 0) for r in pc_reqs)
+        avoided = sum(r.get("prefix_tokens", 0) for r in pc_reqs)
+        prompts = sum(r.get("prompt_tokens", 0) for r in pc_reqs
+                      if r.get("status") != "shed")
+        # the engine's own gauge uses per-ADMISSION prompt tokens in
+        # the denominator; the record-derived fallback counts each
+        # request's prompt once while prefix_tokens accumulates over
+        # re-admissions, so it is clamped (a preempted-and-rehit
+        # request could otherwise push it past 100%)
+        rate = gauges.get("serve_prefix_hit_rate")
+        if rate is None:
+            rate = min(1.0, avoided / max(1, prompts))
+        out.append("## Prefix cache\n")
+        out.append(f"- blocks aliased: {aliased}, prefill tokens "
+                   f"avoided: {avoided} (hit rate {rate:.0%} of "
+                   "admitted prompt tokens)")
+        hits = sum(1 for r in pc_reqs if r.get("prefix_blocks", 0) > 0)
+        out.append(f"- requests that hit: {hits}/{len(pc_reqs)}")
+        saved = gauges.get("serve_prefix_pool_saved_bytes")
+        if saved:
+            out.append(
+                f"- pool bytes saved by sharing at last tick: "
+                f"{saved / 1024:.1f} KiB — measured from block "
+                "refcounts (each holder beyond a block's first), not "
+                "modeled")
+        warm = gauges.get("serve_prefix_cached_blocks")
+        if warm is not None:
+            out.append(f"- warm blocks held by the radix tree at last "
+                       f"tick: {warm:.0f}")
         out.append("")
 
     # -- fleet --------------------------------------------------------------

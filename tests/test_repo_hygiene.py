@@ -352,3 +352,61 @@ def test_fleet_schema_v8_names():
         "action": "replica 0 died; journal replayed onto replica 1",
     })
     assert not errs, errs
+
+
+def test_prefix_tenancy_schema_v9_names():
+    """Schema-v9 drift guard (shared-prefix KV reuse + multi-tenant
+    serving): the serve_prefix_* / serve_tenants_active gauges must
+    stay documented AND registered by the engine, the request-record
+    tenant/prefix fields must stay validatable, the ServeConfig knobs
+    the bench/docs name must exist, and the chaos tenant_flood kind the
+    isolation pin keys on must survive — `report_run.py --check`
+    hard-fails any v9 sidecar otherwise."""
+    from tiny_deepspeed_tpu.telemetry import schema
+
+    assert schema.SCHEMA_VERSION >= 9
+    v9_gauges = {"serve_prefix_hit_rate", "serve_prefix_blocks_aliased",
+                 "serve_prefix_tokens_avoided",
+                 "serve_prefix_cached_blocks",
+                 "serve_prefix_pool_saved_bytes", "serve_tenants_active"}
+    assert v9_gauges <= set(schema.GAUGES), (
+        v9_gauges - set(schema.GAUGES))
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "serving", "engine.py")) as f:
+        engine_src = f.read()
+    for g in sorted(v9_gauges):
+        assert f'"{g}"' in engine_src, (
+            f"gauge {g} documented in schema but no longer registered "
+            "by serving/engine.py"
+        )
+    # the knobs serve_bench/BENCH_PREFIX and the docs name
+    for knob in ("prefix_cache", "tenants"):
+        assert knob in engine_src, f"ServeConfig.{knob} gone"
+    for field in ("tenant", "prefix_blocks", "prefix_tokens"):
+        assert field in schema.META_FIELDS, field
+        assert field in engine_src, (
+            f"{field} gone from serving/engine.py record stamping"
+        )
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "resilience", "chaos.py")) as f:
+        chaos_src = f.read()
+    assert "tenant_flood" in chaos_src, (
+        "chaos tenant_flood kind gone — the multi-tenant isolation "
+        "pin and serve_bench flood A/B key on it"
+    )
+    # a v9 request record (tenant + prefix attribution) validates
+    errs = schema.validate_record({
+        "kind": "request", "ts": 0.0, "request_id": 1,
+        "prompt_tokens": 72, "new_tokens": 8, "preemptions": 0,
+        "status": "ok", "finish": "length", "tenant": "pro",
+        "prefix_blocks": 4, "prefix_tokens": 64,
+    })
+    assert not errs, errs
+    # tenant_queue_watermark shed reason reaches records unchanged
+    errs = schema.validate_record({
+        "kind": "request", "ts": 0.0, "request_id": 2,
+        "prompt_tokens": 8, "new_tokens": 0, "preemptions": 0,
+        "status": "shed", "finish": "shed:tenant_queue_watermark",
+        "tenant": "abuser",
+    })
+    assert not errs, errs

@@ -161,6 +161,13 @@ class TestContinuousBatching:
             )
             assert r.state == "done" and r.finish_reason == "length"
 
+    @pytest.mark.slow  # redundant in tier-1 since ISSUE 13: the
+    # prefix-cache choreography (test_serving_prefix.py::
+    # TestPrefixServing) admits a COLD boundary-length prompt through
+    # this same plain full-prefill path (its first request, p == 2*bt)
+    # and pins token parity — the boundary +1-block rule stays quick
+    # there; this dedicated two-prompt variant keeps the coverage in
+    # the slow tier
     def test_block_boundary_prompt_parity(self, model, params):
         """Prompt length exactly on a block boundary (p % block_tokens
         == 0): the first decode write lands at position p, i.e. in a
@@ -184,6 +191,13 @@ class TestContinuousBatching:
                 err_msg=f"boundary request {r.id} diverged",
             )
 
+    @pytest.mark.slow  # redundant in tier-1 since ISSUE 13: realloc
+    # cleanliness is now exercised HARDER quick by the refcounted-pool
+    # tests (test_serving_prefix.py) — LIFO realloc determinism is
+    # pinned at the pool level, and the prefix choreography reuses
+    # tree-evicted blocks mid-trace with per-tick refcount accounting
+    # + token parity; this engine-level variant keeps the
+    # evictee-block-overlap assertion in the slow tier
     def test_block_realloc_after_eviction_is_clean(self, model, params):
         """A request admitted AFTER an eviction reuses the evictee's
         freed blocks (the free list is LIFO, so they come back first)
@@ -334,6 +348,12 @@ class TestCacheDtypeKnob:
 
 
 class TestServingTelemetry:
+    @pytest.mark.slow  # redundant in tier-1 since ISSUE 13: the
+    # prefix-cache choreography (test_serving_prefix.py) validates a
+    # full engine record file against the schema (superset: v9 tenant/
+    # prefix fields + gauges), and test_serve_observability pins the
+    # plain request-record field surface quick; the gauge registry/
+    # GAUGES cross-check stays quick via the repo-hygiene grep guard
     def test_gauges_counters_and_request_records(self, model, params,
                                                  tmp_path):
         from tiny_deepspeed_tpu.serving import ServingEngine
@@ -369,6 +389,12 @@ class TestServingTelemetry:
             kinds = [json.loads(ln).get("kind") for ln in f]
         assert kinds.count("request") == 2
 
+    @pytest.mark.slow  # redundant in tier-1 since ISSUE 13: the
+    # tenant-isolation pin (test_serving_prefix.py) drives the SAME
+    # run_trace closed-loop path with richer asserts (per-tenant
+    # aggregates + status counts), and the staggered-parity test keeps
+    # plain-engine scheduling quick; this smoke keeps the poisson_trace
+    # shape assertions in the slow tier
     def test_driver_closed_loop_smoke(self, model, params):
         """poisson_trace + run_trace (the serve_bench/BENCH_SERVE code
         path), closed-loop so the smoke never sleeps."""

@@ -138,9 +138,10 @@ class DisaggEngine:
     # -- scheduling ---------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens, *, deadline_s=None,
-               seed=None):
+               seed=None, tenant=None):
         return self.prefill.submit(prompt, max_new_tokens,
-                                   deadline_s=deadline_s, seed=seed)
+                                   deadline_s=deadline_s, seed=seed,
+                                   tenant=tenant)
 
     def tick(self) -> int:
         produced = self.prefill.tick(decode=False)

@@ -50,6 +50,13 @@ from .failover import fail_over
 # flapping replica without starving it outright
 _HEALTH_WEIGHT = 0.5
 
+# tenant-affinity bonus: the replica that last served a tenant scores
+# this much lighter for that tenant's next request — its prefix cache
+# already holds the tenant's system prompts (warm hits) and its
+# scheduler already tracks the tenant's budget, but the bonus stays
+# well under one queued request so real load imbalance still wins
+_TENANT_AFFINITY = 0.25
+
 
 class _LockedLogger:
     """Serializes a shared MetricsLogger across concurrently ticking
@@ -177,6 +184,10 @@ class FleetRouter:
         self._failovers = 0
         self._door_sheds = 0
         self._ticks = 0
+        # tenant -> replica id of the last dispatch (the affinity the
+        # score rewards: that replica's prefix cache is warm for this
+        # tenant's shared prompts)
+        self._tenant_last: Dict[str, int] = {}
         self._update_gauges()
 
     # -- dispatch -----------------------------------------------------------
@@ -184,9 +195,12 @@ class FleetRouter:
     def _live(self) -> List[Replica]:
         return [r for r in self.replicas if r.alive]
 
-    def _score(self, r: Replica) -> Tuple[float, float, int]:
+    def _score(self, r: Replica,
+               tenant: Optional[str] = None) -> Tuple[float, float, int]:
         """Dispatch score, lower = better.  Primary: backlog priced by
-        the measured per-token decode wall, plus the health penalty;
+        the measured per-token decode wall, plus the health penalty,
+        minus the tenant-affinity bonus when this replica last served
+        `tenant` (its prefix cache is warm for that tenant's prompts);
         secondary: pool pressure; final tie-break: replica id (a cold
         even fleet fills deterministically, lowest id first)."""
         eng = r.raw
@@ -195,7 +209,10 @@ class FleetRouter:
                 + eng.n_active / max(1, eng.config.max_active))
         health = eng._quarantined + eng._restarts
         pool = eng.pool.blocks_in_use / eng.pool.num_usable
-        return (load * (1.0 + gap) + _HEALTH_WEIGHT * health, pool, r.id)
+        primary = load * (1.0 + gap) + _HEALTH_WEIGHT * health
+        if tenant is not None and self._tenant_last.get(tenant) == r.id:
+            primary -= _TENANT_AFFINITY
+        return (primary, pool, r.id)
 
     def _meets(self, r: Replica, max_new_tokens: int,
                deadline_s: Optional[float]) -> bool:
@@ -211,35 +228,52 @@ class FleetRouter:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                deadline_s: Optional[float] = None,
-               seed: Optional[int] = None) -> Request:
+               seed: Optional[int] = None,
+               tenant: Optional[str] = None) -> Request:
         """Dispatch one request to the best live replica — or shed it
         AT THE DOOR when no live replica prices its deadline as
-        meetable (the handle returns already terminal, exactly like an
-        engine watermark shed)."""
+        meetable, or (tenant-aware) when every live replica's door
+        watermark for this tenant is already full (the handle returns
+        already terminal, exactly like an engine watermark shed).
+        Dispatch scoring is tenant-aware too: the replica that last
+        served this tenant gets the prefix-affinity bonus."""
         live = self._live()
         if not live:
             raise RuntimeError("no live replicas to dispatch to")
-        feasible = [r for r in live
-                    if self._meets(r, max_new_tokens, deadline_s)]
-        if not feasible:
-            # unmeetable everywhere: shed without touching any queue.
-            # The least-loaded replica's terminal path writes the
-            # record (its logger/telemetry own the request stream).
+
+        def door_shed(reason: str) -> Request:
+            # shed without touching any queue; the least-loaded
+            # replica's terminal path writes the record (its logger /
+            # telemetry own the request stream)
             req = Request(list(prompt), int(max_new_tokens),
-                          deadline_s=deadline_s, seed=seed)
+                          deadline_s=deadline_s, seed=seed,
+                          tenant=tenant)
             best = min(live, key=self._score)
             best.raw._count("serve_submitted")
-            best.raw._shed_req(req, "fleet_unmeetable")
+            best.raw._shed_req(req, reason)
             self._door_sheds += 1
             self._update_gauges()
             return req
-        r = min(feasible, key=self._score)
+
+        if tenant is not None and all(
+                r.raw.tenant_queue_full(tenant) for r in live):
+            # the abusive tenant's overflow terminates at the FLEET
+            # door — no replica's shared queue absorbs it
+            return door_shed("fleet_tenant_watermark")
+        feasible = [r for r in live
+                    if self._meets(r, max_new_tokens, deadline_s)]
+        if not feasible:
+            return door_shed("fleet_unmeetable")
+        r = min(feasible, key=lambda rep: self._score(rep, tenant))
         req = r.engine.submit(prompt, max_new_tokens,
-                              deadline_s=deadline_s, seed=seed)
+                              deadline_s=deadline_s, seed=seed,
+                              tenant=tenant)
         r.dispatched += 1
         self._dispatched += 1
         if req.status is None:  # not shed at the replica's own door
             self._registry[req.id] = (req, r)
+            if tenant is not None:
+                self._tenant_last[tenant] = r.id
         self._update_gauges()
         return req
 
@@ -366,6 +400,49 @@ class FleetRouter:
         """{replica id: requests dispatched to it} — what the
         least-loaded test and the bench summary read."""
         return {r.id: r.dispatched for r in self.replicas}
+
+    def prefix_stats(self) -> Optional[Dict]:
+        """Fleet-wide shared-prefix outcomes: counters summed over
+        live replicas, hit rate re-derived from the summed tokens
+        (None when no replica runs the cache)."""
+        per = [s for s in (r.raw.prefix_stats() for r in self._live())
+               if s is not None]
+        if not per:
+            return None
+        out = {k: sum(s[k] for s in per)
+               for k in ("hits", "misses", "blocks_aliased",
+                         "prefill_tokens_avoided", "prompt_tokens",
+                         "cached_blocks", "tree_evictions",
+                         "pool_saved_bytes")}
+        out["hit_rate"] = round(
+            out["prefill_tokens_avoided"]
+            / max(1, out["prompt_tokens"]), 4)
+        return out
+
+    def tenant_stats(self) -> Optional[Dict]:
+        """Per-tenant scheduler accounting summed across live replicas
+        (weights come from the first replica reporting the tenant —
+        replicas are homogeneous by construction)."""
+        agg: Dict[str, Dict] = {}
+        for r in self._live():
+            st = r.raw.tenant_stats()
+            if not st:
+                continue
+            for name, d in st.items():
+                if name not in agg:
+                    agg[name] = dict(d)
+                    continue
+                cur = agg[name]
+                for k in ("queued", "admitted_tokens", "sheds",
+                          "budget_granted"):
+                    if k in d:
+                        cur[k] = cur.get(k, 0) + d[k]
+        for d in agg.values():
+            if "budget_granted" in d:
+                d["budget_utilization"] = round(
+                    d["admitted_tokens"]
+                    / max(d["budget_granted"], 1e-9), 4)
+        return agg or None
 
     def _update_gauges(self) -> None:
         if self.telemetry is None:

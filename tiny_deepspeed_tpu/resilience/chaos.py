@@ -53,6 +53,15 @@ counter counts `tick()` calls on the wrapper):
                      catches it one level up and replays the dead
                      replica's journal onto a sibling
                      (fleet/failover.py).
+  * "tenant_flood" — ONE abusive tenant submits `flood_requests`
+                     requests in a burst before the tick (deterministic
+                     prompts from the (seed, kind, tick) rng, tagged
+                     `flood_tenant`): the multi-tenant isolation
+                     machinery (per-tenant watermarks, token budgets,
+                     weighted-fair admission — serving/tenancy.py) must
+                     absorb it without moving a well-behaved tenant's
+                     p99 (the ROADMAP isolation pin,
+                     tests/test_serving_prefix.py).
 """
 
 from __future__ import annotations
@@ -69,7 +78,7 @@ from ..utils.checkpoint import CheckpointKilled, set_io_hook
 
 _KIND_CODE = {"nan": 1, "delay": 2, "sigterm": 3,
               "tick_nan": 4, "tick_delay": 5, "prefill_raise": 6,
-              "journal_kill": 7, "engine_kill": 8}
+              "journal_kill": 7, "engine_kill": 8, "tenant_flood": 9}
 
 
 class Chaos:
@@ -89,9 +98,19 @@ class Chaos:
                  tick_delay_prob: float = 0.0,
                  prefill_raise_steps: Iterable[int] = (),
                  journal_kill_step: Optional[int] = None,
-                 engine_kill_step: Optional[int] = None):
+                 engine_kill_step: Optional[int] = None,
+                 tenant_flood_steps: Iterable[int] = (),
+                 tenant_flood_prob: float = 0.0,
+                 flood_tenant: str = "abuser",
+                 flood_requests: int = 8,
+                 flood_prompt_len: int = 8,
+                 flood_new_tokens: int = 8):
         self.seed = int(seed)
         self.delay_s = float(delay_s)
+        self.flood_tenant = str(flood_tenant)
+        self.flood_requests = int(flood_requests)
+        self.flood_prompt_len = int(flood_prompt_len)
+        self.flood_new_tokens = int(flood_new_tokens)
         self._steps = {
             "nan": frozenset(int(s) for s in nan_steps),
             "delay": frozenset(int(s) for s in delay_steps),
@@ -110,13 +129,16 @@ class Chaos:
                 () if engine_kill_step is None
                 else (int(engine_kill_step),)
             ),
+            "tenant_flood": frozenset(
+                int(s) for s in tenant_flood_steps),
         }
         self._prob = {"nan": float(nan_prob), "delay": float(delay_prob),
                       "sigterm": 0.0,
                       "tick_nan": float(tick_nan_prob),
                       "tick_delay": float(tick_delay_prob),
                       "prefill_raise": 0.0, "journal_kill": 0.0,
-                      "engine_kill": 0.0}
+                      "engine_kill": 0.0,
+                      "tenant_flood": float(tenant_flood_prob)}
         self._write_fails_left = int(ckpt_write_failures)
         self._kill_commit = False
         self.injected: List[Dict] = []  # JSON-safe fault log
@@ -262,6 +284,25 @@ class ChaosServingEngine:
             )
         if self.chaos.fires("tick_delay", t):
             time.sleep(self.chaos.delay_s)
+        if self.chaos.fires("tenant_flood", t):
+            # one abusive tenant bursts N requests through the real
+            # submit() door: watermark sheds, budget throttling, and
+            # weighted-fair admission all see honest traffic.  Prompts
+            # are deterministic from the (seed, kind, tick) rng.
+            ch = self.chaos
+            rng = np.random.default_rng(
+                (ch.seed, _KIND_CODE["tenant_flood"], int(t)))
+            vocab = self.engine.model.config.vocab_size
+            outcomes = []
+            for _ in range(ch.flood_requests):
+                r = self.engine.submit(
+                    rng.integers(0, vocab,
+                                 ch.flood_prompt_len).tolist(),
+                    ch.flood_new_tokens, tenant=ch.flood_tenant)
+                outcomes.append(r.status or "queued")
+            ch.injected[-1]["action"] = (
+                f"tenant {ch.flood_tenant} x{ch.flood_requests}: "
+                + ",".join(outcomes))
         if self.chaos.fires("tick_nan", t):
             active = self.engine.active_slots()
             if active:
@@ -316,13 +357,17 @@ def parse_serving_chaos(spec: str, *, seed: int = 0,
         journal_kill@tick                            journal_kill@9
         engine_kill@tick (fleet: kills the whole     engine_kill@12
         wrapped replica; the router fails it over)
+        flood@tick (one abusive tenant bursts        flood@4
+        requests through submit; needs a tenants-
+        configured engine for the isolation to bite)
 
     Kinds: nan (slot-poison), delay (tick delay), prefill (prefill
-    raise), journal_kill, engine_kill.  The schedule is deterministic
-    from (spec, seed) — the same A/B replays bit-identically."""
+    raise), journal_kill, engine_kill, flood (tenant_flood).  The
+    schedule is deterministic from (spec, seed) — the same A/B replays
+    bit-identically."""
     kinds = {"nan": "tick_nan", "delay": "tick_delay",
              "prefill": "prefill_raise", "journal_kill": "journal_kill",
-             "engine_kill": "engine_kill"}
+             "engine_kill": "engine_kill", "flood": "tenant_flood"}
     steps: Dict[str, List[int]] = {k: [] for k in kinds.values()}
     probs: Dict[str, float] = {}
     journal_kill = None
@@ -363,4 +408,6 @@ def parse_serving_chaos(spec: str, *, seed: int = 0,
         prefill_raise_steps=steps["prefill_raise"],
         journal_kill_step=journal_kill,
         engine_kill_step=engine_kill,
+        tenant_flood_steps=steps["tenant_flood"],
+        tenant_flood_prob=probs.get("tenant_flood", 0.0),
     )

@@ -23,6 +23,12 @@ tests/test_serving.py alongside the telemetry=off convention.
   * `drafter` — draft proposers behind one interface: model-free
                 prompt-lookup ("ngram") and a small same-family draft
                 model ("model:<preset>" / "model:self")
+  * `prefix`  — shared-prefix KV reuse: refcounted radix tree of
+                committed full blocks; admission aliases matched
+                blocks copy-on-write and prefills only the suffix
+  * `tenancy` — multi-tenant admission: weighted-fair stride
+                scheduling, per-tenant token budgets, SLO classes,
+                and door watermarks
 """
 
 from .drafter import ModelDrafter, NgramDrafter, make_drafter
@@ -30,11 +36,14 @@ from .engine import Request, ServeConfig, ServingEngine
 from .guard import DecodeHealthGuard
 from .journal import RequestJournal, ServingKilled
 from .pool import KVPoolView, PagedKVPool, PageRef
+from .prefix import PrefixCache
 from .spec import SpecDecoder
+from .tenancy import TenantPolicy, TenantQueue, parse_tenant_spec
 
 __all__ = [
     "Request", "ServeConfig", "ServingEngine",
     "DecodeHealthGuard", "RequestJournal", "ServingKilled",
     "KVPoolView", "PagedKVPool", "PageRef",
     "SpecDecoder", "NgramDrafter", "ModelDrafter", "make_drafter",
+    "PrefixCache", "TenantPolicy", "TenantQueue", "parse_tenant_spec",
 ]

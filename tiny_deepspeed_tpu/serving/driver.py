@@ -19,12 +19,14 @@ class Arrival(NamedTuple):
     """One trace entry: when (seconds from trace start; 0.0 everywhere
     = closed-loop max-pressure mode), what prompt, how many tokens —
     plus an optional per-request completion deadline (seconds from
-    submission; the engine's SLO machinery sheds/expires around it)."""
+    submission; the engine's SLO machinery sheds/expires around it)
+    and an optional tenant tag (multi-tenant scheduling)."""
 
     at_s: float
     prompt: List[int]
     max_new_tokens: int
     deadline_s: Optional[float] = None
+    tenant: Optional[str] = None
 
 
 def poisson_trace(n_requests: int, *, rate_rps: Optional[float],
@@ -44,6 +46,52 @@ def poisson_trace(n_requests: int, *, rate_rps: Optional[float],
         plen = int(rng.choice(np.asarray(prompt_lens)))
         prompt = rng.integers(0, vocab_size, size=plen).tolist()
         trace.append(Arrival(t, prompt, max_new_tokens, deadline_s))
+    return trace
+
+
+def shared_prefix_trace(n_requests: int, *,
+                        rate_rps: Optional[float],
+                        prefix_pool: int, prefix_len: int,
+                        suffix_lens: Sequence[int],
+                        max_new_tokens: int, vocab_size: int,
+                        zipf_a: float = 1.2, seed: int = 0,
+                        deadline_s: Optional[float] = None,
+                        tenants: Optional[dict] = None) -> List[Arrival]:
+    """The millions-of-users workload shape: `prefix_pool` distinct
+    system prompts of `prefix_len` tokens, each arrival picking one
+    Zipf-weighted (a few prompts dominate, a long tail exists — the
+    regime prefix caching exists for) and appending a random suffix
+    drawn from `suffix_lens`.  `tenants` maps tenant name -> arrival
+    weight; each arrival is tagged with a tenant drawn from the
+    normalized weights (None = untagged traffic).  Seeded — the same
+    trace replays against every engine configuration, which is what
+    makes the cache-on/off A/B one workload."""
+    if prefix_pool < 1 or prefix_len < 1:
+        raise ValueError("prefix_pool and prefix_len must be >= 1")
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(0, vocab_size, size=prefix_len).tolist()
+                for _ in range(prefix_pool)]
+    # Zipf over the prefix pool: rank r with weight 1/(r+1)^a
+    w = 1.0 / np.arange(1, prefix_pool + 1, dtype=np.float64) ** zipf_a
+    w /= w.sum()
+    names, tw = None, None
+    if tenants:
+        names = sorted(tenants)
+        tw = np.asarray([float(tenants[n]) for n in names])
+        tw = tw / tw.sum()
+    t = 0.0
+    trace = []
+    for _ in range(n_requests):
+        if rate_rps is not None:
+            t += float(rng.exponential(1.0 / rate_rps))
+        i = int(rng.choice(prefix_pool, p=w))
+        slen = int(rng.choice(np.asarray(suffix_lens)))
+        prompt = prefixes[i] + rng.integers(
+            0, vocab_size, size=slen).tolist()
+        tenant = (str(rng.choice(names, p=tw))
+                  if names is not None else None)
+        trace.append(Arrival(t, prompt, max_new_tokens, deadline_s,
+                             tenant))
     return trace
 
 
@@ -106,10 +154,18 @@ def run_trace(engine, trace: Sequence[Arrival], *,
                     break
             a = pending.pop(0)
             req = engine.submit(
-                a.prompt, a.max_new_tokens, deadline_s=a.deadline_s)
+                a.prompt, a.max_new_tokens, deadline_s=a.deadline_s,
+                tenant=a.tenant)
             requests.append(req)
             if not realtime and req.status is not None:
-                break  # watermark shed: the engine is refusing load
+                # a TENANT-scoped door shed refuses one tenant, not the
+                # engine — other tenants' arrivals must keep feeding or
+                # the abuser's sheds would inflate the well-behaved
+                # tenants' measured TTFT (the isolation A/B's number)
+                if str(req.finish_reason or "").endswith(
+                        "tenant_queue_watermark"):
+                    continue
+                break  # engine-level watermark: it is refusing load
         if (realtime and not engine.queue_depth and not engine.n_active
                 and pending):
             # open-loop idle: nothing in flight, next arrival is in the
@@ -150,6 +206,43 @@ def run_trace(engine, trace: Sequence[Arrival], *,
         k: round(sum(r.lat_components[k] for r in requests), 4)
         for k in ("queue", "prefill", "decode", "preempt", "restart")
     }
+    # per-tenant aggregates (absent on untagged traffic): goodput,
+    # p99 TTFT / end-to-end latency, and terminal outcomes per tenant
+    # — the ONE surface the bench, the report, and the isolation pin
+    # all read
+    by_tenant: dict = {}
+    for r in requests:
+        if r.tenant is not None:
+            by_tenant.setdefault(r.tenant, []).append(r)
+    tenants_out = None
+    if by_tenant:
+        tenants_out = {}
+        for name in sorted(by_tenant):
+            rs = by_tenant[name]
+            ttfts = [r.t_first - r.t_arrival for r in rs
+                     if r.t_first is not None]
+            lats_t = [r.t_done - r.t_arrival for r in rs
+                      if r.t_done is not None]
+            sc = {"ok": 0, "shed": 0, "expired": 0, "failed": 0}
+            for r in rs:
+                sc[r.status] = sc.get(r.status, 0) + 1
+            tenants_out[name] = {
+                "requests": len(rs),
+                "status_counts": sc,
+                "tokens": sum(len(r.tokens) for r in rs),
+                "ok_tokens_per_s": round(
+                    sum(len(r.tokens) for r in rs
+                        if r.status == "ok") / max(wall, 1e-9), 2),
+                "ttft": _latency_stats(ttfts),
+                "latency": _latency_stats(lats_t),
+            }
+        ts = getattr(engine, "tenant_stats", lambda: None)()
+        if ts:
+            for name, st in ts.items():
+                if name in tenants_out:
+                    tenants_out[name]["scheduler"] = st
+    # shared-prefix cache aggregate (absent with the cache off)
+    prefix_out = getattr(engine, "prefix_stats", lambda: None)()
     # speculative-decoding aggregate (zeros stay absent: a spec-off
     # trace reports exactly the pre-spec dict)
     spec_proposed = sum(r.spec_proposed for r in requests)
@@ -187,6 +280,10 @@ def run_trace(engine, trace: Sequence[Arrival], *,
     }
     if spec is not None:
         out["spec"] = spec
+    if tenants_out is not None:
+        out["tenants"] = tenants_out
+    if prefix_out is not None:
+        out["prefix_cache"] = prefix_out
     return out
 
 

@@ -135,8 +135,10 @@ from .guard import DecodeHealthGuard
 from .journal import RequestJournal, ServingKilled
 from .pool import (
     SCRATCH_BLOCK, BlockPayload, PagedKVPool, export_blocks,
-    import_blocks, page_ref,
+    import_blocks, page_ref, paged_append_span,
 )
+from .prefix import PrefixCache
+from .tenancy import TenantPolicy, TenantQueue
 
 # decode-wall samples needed before deadline shedding trusts its price
 # estimate (a cold engine must not shed on compile-time noise)
@@ -205,6 +207,24 @@ class ServeConfig:
     # deterministic under the (seed, position) keys.
     spec_draft: Optional[str] = None
     spec_k: int = 4
+    # shared-prefix KV reuse (serving/prefix.py): admission walks a
+    # radix tree of committed full blocks keyed by token prefix,
+    # aliases matched blocks into the new request's block table
+    # (refcounted — copy-on-write discipline: every writable block
+    # stays private), and prefills only the unmatched suffix through a
+    # span program riding the spec-verify attention.  Greedy output is
+    # token-identical with the cache on or off; the tree keeps finished
+    # requests' prompt blocks warm and yields them LRU under pool
+    # pressure.  Does not compose with spec_draft (the suffix prefill
+    # and the draft span both own the span path — refused loudly).
+    prefix_cache: bool = False
+    # multi-tenant serving (serving/tenancy.py): {tenant: TenantPolicy}
+    # swaps FIFO admission for weighted-fair stride scheduling with
+    # per-tenant token budgets, door watermarks, and SLO-class default
+    # deadlines; submit() takes tenant=.  Tenants NOT in the dict get
+    # default policy (weight 1, no budget) — set it empty ({}) to tag
+    # requests per tenant with everyone at defaults.
+    tenants: Optional[Dict[str, TenantPolicy]] = None
 
 
 class Request:
@@ -220,11 +240,21 @@ class Request:
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int, *,
                  deadline_s: Optional[float] = None,
-                 seed: Optional[int] = None, id: Optional[int] = None):
+                 seed: Optional[int] = None, id: Optional[int] = None,
+                 tenant: Optional[str] = None):
         self.id = next(Request._ids) if id is None else int(id)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_s = None if deadline_s is None else float(deadline_s)
+        # multi-tenant serving: which tenant submitted this request
+        # (None on untagged traffic) — drives the weighted-fair queue,
+        # per-tenant watermarks/SLO class, and the record's attribution
+        self.tenant = None if tenant is None else str(tenant)
+        # shared-prefix cache accounting, cumulative over this
+        # request's admissions: blocks aliased from the radix tree and
+        # prompt tokens whose prefill those aliases avoided
+        self.prefix_blocks = 0
+        self.prefix_tokens = 0
         # per-request sampling seed: with temperature > 0, token i draws
         # from fold(fold(engine_base_key, seed), i) — deterministic
         # across preemption/restart/recovery (module docstring)
@@ -348,6 +378,14 @@ class ServingEngine:
             )
         if config.max_active < 1:
             raise ValueError("max_active must be >= 1")
+        if config.prefix_cache and config.spec_draft is not None:
+            raise ValueError(
+                "prefix_cache does not compose with spec_draft: the "
+                "suffix prefill and the draft span both own the span "
+                "program, and the drafter's accept-or-residual commit "
+                "is not wired through the suffix path — run one or "
+                "the other"
+            )
         self.model = model
         self.params = params
         self.config = config
@@ -380,7 +418,16 @@ class ServingEngine:
         # request; unused entries point at scratch
         self.max_blocks_per_req = -(-self.max_seq // config.block_tokens)
         self._slots: List[Optional[_Slot]] = [None] * config.max_active
-        self._queue: Deque[Request] = deque()
+        # admission queue: plain FIFO, or the weighted-fair per-tenant
+        # stride scheduler when tenants are configured
+        self._queue: Union[Deque[Request], TenantQueue] = (
+            TenantQueue(config.tenants) if config.tenants is not None
+            else deque())
+        # shared-prefix radix tree (None = cache off; rebuilt empty
+        # with the pool on warm restart)
+        self._prefix: Optional[PrefixCache] = (
+            PrefixCache(config.block_tokens) if config.prefix_cache
+            else None)
         self._guard = (DecodeHealthGuard(config.guard_k_restart)
                        if config.health_guard else None)
         self._ticks = 0
@@ -463,6 +510,39 @@ class ServingEngine:
         self._prefill_fn = jax.jit(prefill_step, donate_argnums=(5,))
         # "h.*" compute-dtype cast once — params are frozen while serving
         self._stacked = jax.jit(model.stacked_compute_params)(params)
+        # shared-prefix suffix prefill: when admission aliased m full
+        # blocks, only the UNMATCHED suffix runs — a span program (the
+        # spec-verify attention pointed at prefill): suffix tokens
+        # embed at their absolute positions, attend to the aliased
+        # prefix through the block tables plus themselves under the
+        # windowed causal mask, the first token samples at the true
+        # last prompt position, and the suffix K/V commits through
+        # `paged_append_span` (pad offsets past `count` route to
+        # scratch).  Compiled per power-of-two suffix bucket, exactly
+        # like the full prefill's prompt buckets.
+        if config.prefix_cache:
+            block_size = c.block_size
+
+            def prefill_suffix_step(params, stacked, span, tables, pos0,
+                                    last_off, count, view, seed, nprod):
+                k1 = span.shape[1]
+                positions = jnp.minimum(
+                    pos0[:, None] + jnp.arange(k1)[None, :],
+                    block_size - 1)
+                x = model._embed_decode_span(params, span, positions)
+                page = page_ref(tables, pos0, bt)
+                x, sks, svs = model.paged_verify(stacked, x, view, page)
+                logits = model.head(params, x, position=last_off)[:, 0]
+                nxt = sample_logits_at(logits, base_key, seed, nprod,
+                                       temp, top_k)
+                view = paged_append_span(view, sks, svs, tables, pos0,
+                                         count, bt)
+                return nxt, view
+
+            self._prefill_suffix_fn = jax.jit(prefill_suffix_step,
+                                              donate_argnums=(7,))
+        else:
+            self._prefill_suffix_fn = None
         # speculative decoding: the drafter + ONE compiled verify
         # program (serving/spec.py); imported lazily so the spec-off
         # engine's import graph — and its compiled programs — are
@@ -528,12 +608,16 @@ class ServingEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                deadline_s: Optional[float] = None,
-               seed: Optional[int] = None) -> Request:
+               seed: Optional[int] = None,
+               tenant: Optional[str] = None) -> Request:
         """Queue one request; returns its handle (tokens accumulate on
         it as ticks produce them).  `deadline_s` attaches a completion
         SLO (seconds from now); `seed` pins the temperature>0 sampling
-        stream (default: the request id).  Above the admission
-        watermarks the request comes back already terminal with
+        stream (default: the request id); `tenant` tags the request's
+        owner when multi-tenancy is configured — its policy's SLO-class
+        deadline applies when the request carries none, and its door
+        watermark/budget/weight govern admission.  Above any admission
+        watermark the request comes back already terminal with
         status "shed" — check `req.status`, not an exception: overload
         is an expected outcome, a malformed request is not (those still
         raise ValueError)."""
@@ -555,16 +639,32 @@ class ServingEngine:
                 f"{self.pool.num_usable} — raise num_blocks or shrink "
                 "the request"
             )
-        req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
-                      seed=seed)
-        self._count("serve_submitted")
         cfg = self.config
+        if deadline_s is None and isinstance(self._queue, TenantQueue):
+            # SLO class: the tenant's default completion deadline
+            deadline_s = self._queue.policy(tenant).deadline_s
+        req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
+                      seed=seed, tenant=tenant)
+        self._count("serve_submitted")
+        if isinstance(self._queue, TenantQueue):
+            tq = self._queue.policy(tenant).max_queue
+            if tq is not None and self._queue.depth(tenant) >= tq:
+                # the isolation primitive: a flooding tenant's overflow
+                # sheds at ITS OWN watermark and never reaches the
+                # shared queue/pool
+                self._queue.note_shed(tenant)
+                self._shed_req(req, "tenant_queue_watermark")
+                return req
         if cfg.max_queue is not None and len(self._queue) >= cfg.max_queue:
             self._shed_req(req, "queue_watermark")
             return req
         if (cfg.shed_pool_util is not None and self._queue
-                and self.pool.blocks_in_use / self.pool.num_usable
-                >= cfg.shed_pool_util):
+                # raw utilization first (O(1)): effective <= raw, so
+                # the O(tree) reclaimable walk only runs when the raw
+                # number already trips the watermark
+                and (self.pool.blocks_in_use / self.pool.num_usable
+                     >= cfg.shed_pool_util)
+                and self._effective_pool_util() >= cfg.shed_pool_util):
             self._shed_req(req, "pool_watermark")
             return req
         if self.journal is not None:
@@ -656,6 +756,12 @@ class ServingEngine:
         path load-bearing: without the check it fails deep inside pool
         scatter with no hint which side is wrong.
 
+        Prefix cache: a recovering engine starts WARM-FROM-EMPTY — the
+        radix tree indexed the dead engine's pool, which died with it.
+        Replay is exact regardless (the cache only changes where K/V
+        is read from, never the committed tokens), and the re-admitted
+        requests re-warm the tree as they prefill.
+
         `adopt` maps request id -> an EXISTING Request handle to reuse
         (fleet failover: the dead replica's callers keep their handles
         — the sibling resets each to its committed prefix and continues
@@ -720,7 +826,7 @@ class ServingEngine:
             else:
                 req = Request(e["prompt"], e["max_new"],
                               deadline_s=e["deadline_s"], seed=e["seed"],
-                              id=e["id"])
+                              id=e["id"], tenant=e.get("tenant"))
                 req.tokens = list(e["tokens"])
                 # the wait from recovery to re-admission is restart
                 # overhead, not queue wait: the crash-restart cycle (not
@@ -911,20 +1017,78 @@ class ServingEngine:
         warm-restarts."""
         self._prefill_exc = exc
 
+    def _prefix_saved_bytes(self) -> int:
+        """Pool bytes sharing is saving RIGHT NOW, measured from the
+        refcounts: every holder beyond a block's first would need its
+        own physical block without aliasing.  Block bytes come off the
+        device arrays' dtypes (resting dtype + scales), not a model."""
+        if self._prefix is None:
+            return 0
+        excess = sum(n - 1 for n in self.pool.ref_counts().values()
+                     if n > 1)
+        if not excess:
+            return 0
+        kb = self.pool.kv_bytes()
+        total_blocks = self.pool.num_usable + 1  # + scratch
+        return int(excess * kb["total_bytes"] / total_blocks)
+
+    def prefix_stats(self) -> Optional[Dict]:
+        """Shared-prefix cache outcomes (None with the cache off):
+        hit rate = prompt tokens aliased / prompt tokens admitted,
+        plus the raw counters and the measured bytes-of-pool saved."""
+        if self._prefix is None:
+            return None
+        pc = self._prefix
+        return {
+            "hit_rate": round(
+                pc.tokens_avoided / max(1, pc.prompt_tokens), 4),
+            "hits": pc.hits, "misses": pc.misses,
+            "blocks_aliased": pc.blocks_aliased,
+            "prefill_tokens_avoided": pc.tokens_avoided,
+            "prompt_tokens": pc.prompt_tokens,
+            "cached_blocks": len(pc),
+            "tree_evictions": pc.evicted,
+            "pool_saved_bytes": self._prefix_saved_bytes(),
+        }
+
+    def tenant_stats(self) -> Optional[Dict]:
+        """Per-tenant scheduler accounting (None without tenants):
+        queued depth, admitted token cost, weight, door sheds, and
+        budget utilization when a budget is configured."""
+        if not isinstance(self._queue, TenantQueue):
+            return None
+        return self._queue.stats()
+
+    def tenant_queue_full(self, tenant: Optional[str]) -> bool:
+        """Whether a submit() for `tenant` would shed at its own door
+        watermark right now — the fleet router's tenant-aware door
+        check (fleet/router.py)."""
+        if not isinstance(self._queue, TenantQueue):
+            return False
+        tq = self._queue.policy(tenant).max_queue
+        return tq is not None and self._queue.depth(tenant) >= tq
+
     def describe(self) -> str:
         q = self.config.quant or str(jnp.dtype(self.pool.view.k.dtype))
         spec = (f", {self._spec.describe()}"
                 if self._spec is not None else "")
+        extras = ""
+        if self._prefix is not None:
+            extras += f", prefix_cache={len(self._prefix)} blocks"
+        if isinstance(self._queue, TenantQueue):
+            extras += f", tenants={len(self.config.tenants)}"
         return (
             f"serving(max_active={self.config.max_active}, "
             f"blocks={self.pool.num_usable}x"
             f"{self.config.block_tokens}, cache={q}, "
-            f"guard={'on' if self._guard else 'off'}{spec})"
+            f"guard={'on' if self._guard else 'off'}{spec}{extras})"
         )
 
     # -- scheduler internals ------------------------------------------------
 
     def _tick_body(self, decode: bool = True) -> int:
+        if isinstance(self._queue, TenantQueue):
+            self._queue.on_tick()  # per-tenant budget accrual
         self._enforce_deadlines(time.monotonic())
         # growth first: existing slots claim the blocks their next write
         # needs BEFORE admission can take them — the other order lets a
@@ -1122,22 +1286,26 @@ class ServingEngine:
         if self._queue and any(r.deadline is not None
                                for r in self._queue):
             gap = self._gap_p50()
-            keep: Deque[Request] = deque()
-            for req in self._queue:
+            for req in list(self._queue):
                 dl = req.deadline
                 if dl is None:
-                    keep.append(req)
                     continue
+                reason = None
                 if now >= dl:
-                    self._shed_req(req, "deadline_overdue")
-                    continue
-                remaining = req.max_new_tokens - len(req.tokens)
-                # +1 tick for the prefill it still has to pay
-                if gap is not None and now + (remaining + 1) * gap > dl:
-                    self._shed_req(req, "deadline_unmeetable")
-                    continue
-                keep.append(req)
-            self._queue = keep
+                    reason = "deadline_overdue"
+                else:
+                    remaining = req.max_new_tokens - len(req.tokens)
+                    # +1 tick for the prefill it still has to pay
+                    if (gap is not None
+                            and now + (remaining + 1) * gap > dl):
+                        reason = "deadline_unmeetable"
+                if reason is not None:
+                    # remove() works on the plain deque AND the tenant
+                    # queue (which keeps its per-tenant FIFOs intact)
+                    self._queue.remove(req)
+                    if isinstance(self._queue, TenantQueue):
+                        self._queue.note_shed(req.tenant)
+                    self._shed_req(req, reason)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -1155,20 +1323,103 @@ class ServingEngine:
             b *= 2
         return min(b * bt, self.model.config.block_size)
 
+    def _bucket_span(self, n: int) -> int:
+        """Suffix-prefill pad length: the smallest power of two >= n
+        (no block-multiple constraint — the span program commits
+        through `count`, not a scatter panel)."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.model.config.block_size)
+
+    def _prefill_operands(self, prompt_now: List[int], ids: List[int]):
+        """The full-prompt prefill program's (padded prompt, block-id
+        panel) operands — shared by the plain and spec admission
+        paths."""
+        p = len(prompt_now)
+        bt = self.config.block_tokens
+        bucket = self._bucket(p)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p] = prompt_now
+        block_ids = np.full((bucket // bt,), SCRATCH_BLOCK, np.int32)
+        # the prefill panel only spans the bucket; the +1 decode
+        # block can lie past it (boundary p == bucket) — it is
+        # reached through the slot table, not the prefill scatter
+        k = min(len(ids), bucket // bt)
+        block_ids[:k] = ids[:k]
+        return padded, block_ids
+
+    def _next_queued(self) -> Optional[Request]:
+        """The next admission candidate: FIFO head, or the tenant
+        queue's stride-selected request — None when requests are
+        queued but every busy tenant is over budget this tick."""
+        if isinstance(self._queue, TenantQueue):
+            return self._queue.peek()
+        return self._queue[0]
+
+    def _pop_queued(self, req: Request) -> None:
+        if isinstance(self._queue, TenantQueue):
+            self._queue.pop(req)  # charges the tenant's pass + budget
+        else:
+            self._queue.popleft()
+
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """pool.alloc with prefix-tree reclaim: under pressure the
+        radix tree yields its LRU unreferenced leaves (warm cache, no
+        live holder) BEFORE the scheduler resorts to preemption —
+        cached blocks are an optimization, never a reason to evict a
+        running request."""
+        ids = self.pool.alloc(n)
+        if ids is None and self._prefix is not None:
+            if self._prefix.evict(self.pool,
+                                  need=n - self.pool.blocks_free):
+                ids = self.pool.alloc(n)
+        return ids
+
+    def _effective_pool_util(self) -> float:
+        """Pool utilization for the shed watermark: allocated blocks
+        minus what the prefix tree could reclaim right now — a pool
+        full of warm cache is not overloaded, and counting it would
+        turn the cache itself into a shed trigger."""
+        used = self.pool.blocks_in_use
+        if self._prefix is not None:
+            used -= self._prefix.reclaimable(self.pool)
+        return used / self.pool.num_usable
+
     def _admit(self) -> int:
-        """FIFO admission: prefill queued requests into free slots while
-        the pool can hold their prompts.  Head-of-line blocking is
-        deliberate — skipping ahead would starve long prompts."""
+        """Admission: prefill queued requests into free slots while the
+        pool can hold their prompts — FIFO (head-of-line blocking is
+        deliberate: skipping ahead would starve long prompts), or the
+        weighted-fair tenant schedule when tenants are configured.
+        With the prefix cache on, admission first walks the radix tree:
+        matched full blocks alias into the block table (refcounted)
+        and only the unmatched suffix pays a prefill."""
         produced = 0
         while self._queue:
             try:
                 slot_i = self._slots.index(None)
             except ValueError:
                 break
-            req = self._queue[0]
+            req = self._next_queued()
+            if req is None:
+                break  # every queued tenant over budget until next tick
             prompt_now = req.prompt + req.tokens  # preemption continuation
             p = len(prompt_now)
             bt = self.config.block_tokens
+            # shared-prefix match: alias at most (p-1)//bt full blocks
+            # — at least one prompt token always remains for the
+            # suffix program (which also samples the first token), and
+            # every block the request will WRITE stays private
+            alias: List[int] = []
+            if self._prefix is not None:
+                alias = self._prefix.match(
+                    prompt_now, limit=(p - 1) // bt, tick=self._ticks)
+                if alias:
+                    # pin the aliased blocks (this table's refcount)
+                    # BEFORE allocating: the fresh-block alloc may
+                    # evict tree leaves, and a matched node must not
+                    # be reclaimed out from under its own admission
+                    self.pool.share(alias)
             # blocks for the prompt AND its first decode write (position
             # p): same count as ceil(p/bt) except when p lands exactly
             # on a block boundary — without the extra block that first
@@ -1179,17 +1430,22 @@ class ServingEngine:
             # clamped to the request's final position — replaces p:
             # same worst-case block count as the plain path, claimed up
             # front instead of across the first few grows
-            ids = self.pool.alloc(
-                self._write_horizon(req, p) // bt + 1)
-            if ids is None:
+            ids_new = self._alloc(
+                self._write_horizon(req, p) // bt + 1 - len(alias))
+            if ids_new is None:
+                if alias:
+                    self.pool.free_blocks(alias)  # roll the pin back
                 break
-            self._queue.popleft()
+            ids = alias + ids_new
+            self._pop_queued(req)
             if self._prefill_exc is not None:
                 # chaos: the prefill "fails"; put everything back the
                 # way a real mid-admission fault would find it and let
                 # the watchdog take it from here
                 exc, self._prefill_exc = self._prefill_exc, None
                 self.pool.free_blocks(ids)
+                if isinstance(self._queue, TenantQueue):
+                    self._queue.refund(req)  # no work happened
                 self._queue.appendleft(req)
                 raise exc
             t_adm = time.monotonic()
@@ -1203,17 +1459,29 @@ class ServingEngine:
                 req._wait_since = None
             req.event("admitted", t_adm, slot_i)
             req.last_slot = slot_i
-            bucket = self._bucket(p)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :p] = prompt_now
-            block_ids = np.full((bucket // bt,), SCRATCH_BLOCK, np.int32)
-            # the prefill panel only spans the bucket; the +1 decode
-            # block can lie past it (boundary p == bucket) — it is
-            # reached through the slot table, not the prefill scatter
-            k = min(len(ids), bucket // bt)
-            block_ids[:k] = ids[:k]
             try:
-                if self._spec is not None:
+                if alias:
+                    # suffix prefill: the aliased blocks already hold
+                    # positions < p0 — only the unmatched suffix runs,
+                    # through the span program (padded to a power-of-
+                    # two suffix bucket; pad offsets commit nothing)
+                    p0 = len(alias) * bt
+                    suffix = prompt_now[p0:]
+                    k1 = self._bucket_span(len(suffix))
+                    span = np.zeros((1, k1), np.int32)
+                    span[0, :len(suffix)] = suffix
+                    tables = np.full((1, self.max_blocks_per_req),
+                                     SCRATCH_BLOCK, np.int32)
+                    tables[0, :len(ids)] = ids
+                    nxt, view = self._prefill_suffix_fn(
+                        self.params, self._stacked, span, tables,
+                        np.asarray([p0], np.int32),
+                        np.int32(p - 1 - p0),
+                        np.asarray([len(suffix)], np.int32),
+                        self.pool.view, np.int32(req.seed),
+                        np.int32(len(req.tokens)),
+                    )
+                elif self._spec is not None:
                     # the drafter rebuilds this slot's draft cache from
                     # the SAME committed prefix — the one admission
                     # path every resume (preemption, warm restart,
@@ -1222,12 +1490,16 @@ class ServingEngine:
                     # proposal for the first post-prefix position (the
                     # spec prefill's accept-or-residual operand)
                     prop = self._spec.on_admit(slot_i, prompt_now)
+                    padded, block_ids = self._prefill_operands(
+                        prompt_now, ids)
                     nxt, view = self._prefill_fn(
                         self.params, self._stacked, padded, p - 1,
                         block_ids, self.pool.view, np.int32(req.seed),
                         np.int32(len(req.tokens)), np.int32(prop),
                     )
                 else:
+                    padded, block_ids = self._prefill_operands(
+                        prompt_now, ids)
                     nxt, view = self._prefill_fn(
                         self.params, self._stacked, padded, p - 1,
                         block_ids, self.pool.view, np.int32(req.seed),
@@ -1247,11 +1519,22 @@ class ServingEngine:
                 self.pool.free_blocks(ids)
                 req.event("admission_aborted", time.monotonic(), slot_i)
                 req._wait_since = t_adm
+                if isinstance(self._queue, TenantQueue):
+                    self._queue.refund(req)  # no work happened
                 self._queue.appendleft(req)
                 raise
             pf = time.monotonic() - t_adm
             self._seg["prefill_s"] += pf
             req.lat_components["prefill"] += pf
+            if self._prefix is not None:
+                # commit the prompt's full blocks to the radix tree —
+                # new nodes take their own refcount, which is what
+                # keeps them warm after this request's table frees
+                self._prefix.insert(prompt_now, ids[:p // bt],
+                                    self.pool, tick=self._ticks)
+                self._prefix.note_admission(len(alias), p)
+                req.prefix_blocks += len(alias)
+                req.prefix_tokens += len(alias) * bt
             slot = _Slot(req, table=ids, pos=p, last_token=tok,
                          admitted_at=t_adm, prefill_s=pf)
             self._slots[slot_i] = slot
@@ -1292,7 +1575,7 @@ class ServingEngine:
                    and len(slot.table)
                    < self._write_horizon(slot.req, slot.pos)
                    // self.config.block_tokens + 1):
-                ids = self.pool.alloc(1)
+                ids = self._alloc(1)  # prefix tree yields before preemption
                 if ids is not None:
                     slot.table.extend(ids)
                     continue
@@ -1365,6 +1648,15 @@ class ServingEngine:
         self._slots = [None] * self.config.max_active
         self._poison_pending.clear()
         self.pool = PagedKVPool(**self._pool_args)
+        if self._prefix is not None:
+            # the tree indexes blocks of the pool that just died with
+            # the restart — it rebuilds empty alongside (warm-from-
+            # empty, same as journal recovery; lifetime stats carry on)
+            old = self._prefix
+            self._prefix = PrefixCache(self.config.block_tokens)
+            for attr in ("hits", "misses", "blocks_aliased",
+                         "tokens_avoided", "prompt_tokens", "evicted"):
+                setattr(self._prefix, attr, getattr(old, attr))
         if self._guard is not None:
             self._guard.reset()
         self._tick_counts["restarted"] += 1
@@ -1479,6 +1771,14 @@ class ServingEngine:
                 # accepted into this sequence (accept rate = ratio)
                 rec["spec_proposed"] = req.spec_proposed
                 rec["spec_accepted"] = req.spec_accepted
+            if req.tenant is not None:
+                rec["tenant"] = req.tenant
+            if self._prefix is not None:
+                # shared-prefix yield, cumulative over admissions:
+                # blocks aliased from the tree and the prompt tokens
+                # whose prefill those aliases avoided
+                rec["prefix_blocks"] = req.prefix_blocks
+                rec["prefix_tokens"] = req.prefix_tokens
             if req.deadline_s is not None:
                 rec["deadline_s"] = req.deadline_s
             if req.t_admitted is not None:
@@ -1535,6 +1835,23 @@ class ServingEngine:
                     self._spec_accepted / max(1, self._spec_proposed))
             t.gauge("serve_spec_tokens_per_tick",
                     self._spec_tokens / max(1, self._spec_ticks))
+        if self._prefix is not None:
+            pc = self._prefix
+            t.gauge("serve_prefix_hit_rate",
+                    pc.tokens_avoided / max(1, pc.prompt_tokens))
+            t.gauge("serve_prefix_blocks_aliased",
+                    float(pc.blocks_aliased))
+            t.gauge("serve_prefix_tokens_avoided",
+                    float(pc.tokens_avoided))
+            t.gauge("serve_prefix_cached_blocks", float(len(pc)))
+            t.gauge("serve_prefix_pool_saved_bytes",
+                    float(self._prefix_saved_bytes()))
+        if isinstance(self._queue, TenantQueue):
+            active = {r.tenant for r in self._queue}
+            active |= {s.req.tenant for s in self._slots
+                       if s is not None}
+            active.discard(None)
+            t.gauge("serve_tenants_active", float(len(active)))
 
     # -- per-tick time series + serving flight recorder ---------------------
 

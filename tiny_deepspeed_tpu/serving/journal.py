@@ -106,11 +106,17 @@ class RequestJournal:
         self._buf.append(json.dumps(rec) + "\n")
 
     def submit(self, req) -> None:
-        self._append({
+        rec = {
             "ev": "submit", "id": req.id, "prompt": list(req.prompt),
             "max_new": req.max_new_tokens, "deadline_s": req.deadline_s,
             "seed": req.seed,
-        })
+        }
+        tenant = getattr(req, "tenant", None)
+        if tenant is not None:
+            # multi-tenant attribution survives recovery; absent on
+            # untagged traffic so pre-tenancy journals replay unchanged
+            rec["tenant"] = tenant
+        self._append(rec)
 
     def tokens(self, req_id: int, toks: List[int]) -> None:
         if toks:
@@ -225,7 +231,8 @@ class RequestJournal:
                     "id": rid, "prompt": rec["prompt"],
                     "max_new": rec["max_new"],
                     "deadline_s": rec.get("deadline_s"),
-                    "seed": rec.get("seed", rid), "tokens": [],
+                    "seed": rec.get("seed", rid),
+                    "tenant": rec.get("tenant"), "tokens": [],
                 }
             elif ev == "tok" and rid in reqs:
                 reqs[rid]["tokens"].extend(rec["toks"])

@@ -36,7 +36,7 @@ device arrays + free list + exact accounting.
 
 from __future__ import annotations
 
-from typing import List, NamedTuple, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -313,7 +313,21 @@ def paged_scatter(view: KVPoolView, ks, vs, block_ids,
 class PagedKVPool:
     """Host-side pool owner: the device arrays plus exact block
     accounting.  `num_blocks` is the USABLE count — one extra scratch
-    block is allocated on top and never handed out."""
+    block is allocated on top and never handed out.
+
+    Blocks are REFCOUNTED (the prefix-cache extension of the original
+    LIFO free list): `alloc` hands a block out at refcount 1, `share`
+    bumps it for every additional holder (a second request's block
+    table aliasing a shared prefix, or the radix tree keeping a
+    finished request's prompt blocks warm), and `free_blocks` is a
+    DECREMENT — the block returns to the free list only when its last
+    holder lets go.  With no sharing in play every refcount is 1 and
+    the semantics (and the LIFO realloc determinism the tests pin) are
+    byte-identical to the pre-refcount pool.  The exact-accounting
+    invariant becomes: free + distinct-allocated == usable, and every
+    allocated block's refcount equals its holder count (table
+    occurrences + one for a prefix-tree node) — what
+    tests/test_serving_prefix.py asserts per tick."""
 
     def __init__(self, *, n_layer: int, kv_heads: int, head_dim: int,
                  num_blocks: int, block_tokens: int, dtype,
@@ -345,6 +359,9 @@ class PagedKVPool:
         # pop() hands out ascending ids from 1; frees push back LIFO —
         # both deterministic, which the realloc-determinism test pins
         self._free: List[int] = list(range(total - 1, 0, -1))
+        # block id -> holder count, for every allocated block (ids in
+        # the free list never appear here)
+        self._ref: Dict[int, int] = {}
 
     # -- accounting ---------------------------------------------------------
 
@@ -354,22 +371,66 @@ class PagedKVPool:
 
     @property
     def blocks_in_use(self) -> int:
+        """DISTINCT allocated blocks — a block aliased by three holders
+        still occupies one physical block."""
         return self.num_usable - len(self._free)
 
+    def refcount(self, b: int) -> int:
+        """Holder count of block `b` (0 = free)."""
+        return self._ref.get(int(b), 0)
+
+    def ref_counts(self) -> Dict[int, int]:
+        """{block id: holder count} snapshot over every allocated block
+        — what the per-tick exact-accounting pin compares against the
+        holders it can enumerate (active tables + prefix-tree nodes)."""
+        return dict(self._ref)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n physical block ids, or None WITHOUT allocating when fewer
-        than n are free (admission is all-or-nothing)."""
+        """n physical block ids at refcount 1, or None WITHOUT
+        allocating when fewer than n are free (admission is
+        all-or-nothing)."""
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        ids = [self._free.pop() for _ in range(n)]
+        for b in ids:
+            self._ref[b] = 1
+        return ids
+
+    def share(self, ids: List[int]) -> None:
+        """Add one holder to each allocated block in `ids` — the
+        aliasing primitive: a new request's block table (or the prefix
+        tree) referencing blocks some other holder already owns.
+        Sharing a free block is refused: its contents are up for
+        reuse, so an alias would read garbage."""
+        for b in ids:
+            if self._ref.get(b, 0) < 1:
+                raise ValueError(
+                    f"cannot share block {b}: not allocated (a free "
+                    "block's contents are reusable garbage)"
+                )
+        for b in ids:
+            self._ref[b] += 1
 
     def free_blocks(self, ids: List[int]) -> None:
-        for b in ids:
+        """Drop one holder per id; a block whose LAST holder lets go
+        returns to the free list (LIFO, in `ids` order — with all
+        refcounts at 1 this is exactly the pre-refcount extend)."""
+        from collections import Counter
+        drops = Counter(int(b) for b in ids)
+        for b, n in drops.items():
             if not 1 <= b <= self.num_usable:
                 raise ValueError(f"freeing invalid block id {b}")
-            if b in self._free:
-                raise ValueError(f"double free of block {b}")
-        self._free.extend(ids)
+            if self._ref.get(b, 0) < n:
+                raise ValueError(
+                    f"double free of block {b}: {n} release(s) against "
+                    f"refcount {self._ref.get(b, 0)}"
+                )
+        for b in ids:
+            b = int(b)
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._free.append(b)
 
     def kv_bytes(self) -> dict:
         """The pool's resting HBM footprint, FROM the device arrays'

@@ -1,0 +1,286 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Multi-tenant admission: weighted-fair scheduling with per-tenant
+token budgets, SLO classes, and door watermarks.
+
+One undifferentiated FIFO lets a single abusive client starve every
+other tenant's SLO — its burst lands first, head-of-line blocking does
+the rest.  When `ServeConfig.tenants` is set the engine swaps its FIFO
+for the `TenantQueue` here:
+
+  * STRIDE SCHEDULING across per-tenant FIFOs — every tenant carries a
+    `pass` value advanced by admitted-cost / weight; the next admission
+    always comes from the eligible tenant with the minimum pass, so
+    over any contended window tenants admit tokens proportional to
+    their weights (the deficit/stride family; stride keeps the
+    bookkeeping to one counter per tenant and is naturally
+    work-conserving — an idle fleet serves the only busy tenant at
+    full rate regardless of weight).
+  * TOKEN BUDGETS — a tenant with `tokens_per_tick` set accrues budget
+    each scheduler tick (capped at `burst_tokens`), and its head
+    request is only eligible while the budget covers the request's
+    cost; an over-budget tenant is skipped, NOT rotated to later (its
+    own FIFO order is preserved), so a flood burns its own budget and
+    queue while well-behaved tenants admit around it.
+  * SLO CLASSES — `deadline_s` stamps a default completion deadline on
+    the tenant's requests at submit; from there the existing PR-8
+    machinery (queue sheds, active expiry, priced unmeetable sheds) is
+    already per-request and therefore per-tenant for free.
+  * DOOR WATERMARKS — `max_queue` bounds the tenant's OWN queue;
+    beyond it the engine sheds at submit ("tenant_queue_watermark"),
+    which is the isolation primitive: the abusive tenant's overflow
+    never reaches the shared pool at all.
+
+A request's admission cost is `len(prompt) + max_new_tokens` — the
+tokens it will occupy end to end (prefill work + decode work + pool
+footprint are all roughly proportional).  Preemption resume re-charges
+the same cost: the re-prefill is real work, and billing it to the
+owner keeps the scheduler honest about who is consuming the engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, Iterator, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's scheduling contract.  All fields optional: a
+    tenant submitted with no configured policy gets the defaults
+    (weight 1, no budget, no watermark, no SLO class)."""
+
+    # stride-scheduling share: under contention the tenant admits
+    # tokens proportional to weight / sum(weights of busy tenants)
+    weight: float = 1.0
+    # admission token budget: accrual per scheduler tick (None = no
+    # cap — weighted fairness alone)
+    tokens_per_tick: Optional[float] = None
+    # budget accrual ceiling (default 8 ticks' worth): bounds the burst
+    # an idle tenant can save up
+    burst_tokens: Optional[float] = None
+    # per-tenant door watermark: submissions beyond this many queued
+    # requests shed at the door ("tenant_queue_watermark")
+    max_queue: Optional[int] = None
+    # SLO class: default completion deadline stamped on the tenant's
+    # requests when they carry none of their own
+    deadline_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"tenant weight must be > 0, got "
+                             f"{self.weight}")
+        if self.tokens_per_tick is not None and self.tokens_per_tick <= 0:
+            raise ValueError("tokens_per_tick must be > 0 when set")
+
+    @property
+    def burst(self) -> Optional[float]:
+        if self.tokens_per_tick is None:
+            return None
+        return (self.burst_tokens if self.burst_tokens is not None
+                else 8.0 * self.tokens_per_tick)
+
+
+def request_cost(req) -> int:
+    """Admission cost in tokens: prompt + full decode commitment."""
+    return len(req.prompt) + req.max_new_tokens
+
+
+class _TenantState:
+    __slots__ = ("fifo", "pass_v", "budget", "policy",
+                 "admitted_tokens", "budget_granted", "sheds")
+
+    def __init__(self, policy: TenantPolicy):
+        self.fifo: Deque = deque()
+        self.pass_v = 0.0
+        self.policy = policy
+        self.budget = policy.burst  # start full: cold != throttled
+        self.admitted_tokens = 0
+        self.budget_granted = (policy.burst or 0.0)
+        self.sheds = 0
+
+
+class TenantQueue:
+    """Drop-in for the engine's admission deque, scheduling across
+    per-tenant FIFOs.  The engine drives it through the same surface
+    it uses on the plain deque (`append` / `appendleft` / `remove` /
+    iteration / len) plus the scheduler hooks: `on_tick` (budget
+    accrual), `peek` (the stride-selected next admissible request, or
+    None when every busy tenant is out of budget), and `pop(req)`
+    (remove + charge the cost the peek priced)."""
+
+    def __init__(self, policies: Dict[str, TenantPolicy]):
+        self._policies = dict(policies)
+        self._t: Dict[str, _TenantState] = {}
+        # global virtual time: the pass of the last scheduled tenant —
+        # a newly-busy tenant starts here instead of at 0, so going
+        # idle never banks unbounded priority
+        self._vtime = 0.0
+
+    def policy(self, tenant: Optional[str]) -> TenantPolicy:
+        return self._policies.get(tenant) or TenantPolicy()
+
+    def _state(self, tenant: Optional[str]) -> _TenantState:
+        key = tenant or ""
+        st = self._t.get(key)
+        if st is None:
+            st = self._t[key] = _TenantState(self.policy(tenant))
+        return st
+
+    # -- deque-compatible surface ------------------------------------------
+
+    def append(self, req) -> None:
+        st = self._state(getattr(req, "tenant", None))
+        if not st.fifo:
+            st.pass_v = max(st.pass_v, self._vtime)
+        st.fifo.append(req)
+
+    def appendleft(self, req) -> None:
+        """Front of the request's OWN tenant FIFO — preemption resume /
+        recovery keep their within-tenant order; cross-tenant order
+        stays the stride schedule's call."""
+        st = self._state(getattr(req, "tenant", None))
+        if not st.fifo:
+            st.pass_v = max(st.pass_v, self._vtime)
+        st.fifo.appendleft(req)
+
+    def remove(self, req) -> None:
+        self._state(getattr(req, "tenant", None)).fifo.remove(req)
+
+    def clear(self) -> None:
+        for st in self._t.values():
+            st.fifo.clear()
+
+    def __len__(self) -> int:
+        return sum(len(st.fifo) for st in self._t.values())
+
+    def __bool__(self) -> bool:
+        return any(st.fifo for st in self._t.values())
+
+    def __iter__(self) -> Iterator:
+        for key in sorted(self._t):
+            yield from self._t[key].fifo
+
+    def depth(self, tenant: Optional[str]) -> int:
+        st = self._t.get(tenant or "")
+        return len(st.fifo) if st is not None else 0
+
+    # -- scheduler hooks ----------------------------------------------------
+
+    def on_tick(self) -> None:
+        """Budget accrual, once per scheduler tick."""
+        for st in self._t.values():
+            rate = st.policy.tokens_per_tick
+            if rate is None or st.budget is None:
+                continue
+            add = min(rate, st.policy.burst - st.budget)
+            if add > 0:
+                st.budget += add
+                st.budget_granted += add
+
+    def _eligible(self, st: _TenantState) -> bool:
+        if not st.fifo:
+            return False
+        if st.budget is None:
+            return True
+        return st.budget >= request_cost(st.fifo[0])
+
+    def peek(self):
+        """The stride-selected next request: head of the minimum-pass
+        tenant whose budget covers it.  None when tenants are queued
+        but all over budget — admission waits for the next tick's
+        accrual (never a deadlock: on_tick refills every tick)."""
+        best = None
+        for key in sorted(self._t):
+            st = self._t[key]
+            if not self._eligible(st):
+                continue
+            if best is None or (st.pass_v, key) < (best[0].pass_v,
+                                                   best[1]):
+                best = (st, key)
+        return best[0].fifo[0] if best else None
+
+    def pop(self, req) -> None:
+        """Commit the admission `peek` selected: remove `req` and
+        charge its cost to the tenant's pass (stride) and budget."""
+        st = self._state(getattr(req, "tenant", None))
+        assert st.fifo and st.fifo[0] is req, \
+            "pop() must take the request peek() selected"
+        st.fifo.popleft()
+        cost = float(request_cost(req))
+        st.pass_v += cost / st.policy.weight
+        self._vtime = st.pass_v
+        if st.budget is not None:
+            st.budget = max(0.0, st.budget - cost)
+        st.admitted_tokens += int(cost)
+
+    def refund(self, req) -> None:
+        """Undo one `pop` charge — an ABORTED admission (chaos or real
+        prefill exception re-queues the request untouched): without
+        the refund a transient fault bills the tenant full cost for
+        zero work, and the re-admission charges it AGAIN — a
+        budget-capped tenant could starve for ticks behind one flaky
+        prefill.  The caller re-queues the request separately
+        (appendleft)."""
+        st = self._state(getattr(req, "tenant", None))
+        cost = float(request_cost(req))
+        st.pass_v = max(0.0, st.pass_v - cost / st.policy.weight)
+        # the pop advanced vtime to this tenant's charged pass; pull it
+        # back too (the abort raises out of the same admission loop, so
+        # no other pop intervened) — otherwise the re-queue's
+        # idle-rejoin seeding (max(pass, vtime)) re-imposes the charge
+        # the refund just rolled back
+        self._vtime = min(self._vtime, st.pass_v)
+        if st.budget is not None:
+            st.budget = min(st.policy.burst, st.budget + cost)
+        st.admitted_tokens = max(0, st.admitted_tokens - int(cost))
+
+    # -- reporting ----------------------------------------------------------
+
+    def stats(self) -> Dict[str, Dict]:
+        """Per-tenant scheduler accounting for the report surface:
+        admitted token cost, budget granted (accrued, capped) and
+        utilization = admitted / granted when a budget is configured."""
+        out: Dict[str, Dict] = {}
+        for key, st in self._t.items():
+            d = {"queued": len(st.fifo),
+                 "admitted_tokens": st.admitted_tokens,
+                 "weight": st.policy.weight,
+                 "sheds": st.sheds}
+            if st.policy.tokens_per_tick is not None:
+                d["budget_granted"] = round(st.budget_granted, 1)
+                d["budget_utilization"] = round(
+                    st.admitted_tokens / max(st.budget_granted, 1e-9), 4)
+            out[key or "-"] = d
+        return out
+
+    def note_shed(self, tenant: Optional[str]) -> None:
+        self._state(tenant).sheds += 1
+
+
+def parse_tenant_spec(spec: str) -> Dict[str, TenantPolicy]:
+    """CLI tenant spec -> policies: comma list of
+    `name[:weight[:tokens_per_tick[:max_queue]]]` entries, e.g.
+    "pro:4,free:1:64:8".  Empty or 0 trailing fields inherit the
+    defaults (0 means "uncapped", not a zero budget — a zero budget
+    would never admit)."""
+    out: Dict[str, TenantPolicy] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        name = parts[0]
+        kw = {}
+        if len(parts) > 1 and parts[1]:
+            kw["weight"] = float(parts[1])
+        if len(parts) > 2 and parts[2] and float(parts[2]) > 0:
+            kw["tokens_per_tick"] = float(parts[2])
+        if len(parts) > 3 and parts[3] and int(parts[3]) > 0:
+            kw["max_queue"] = int(parts[3])
+        out[name] = TenantPolicy(**kw)
+    if not out:
+        raise ValueError(f"empty tenant spec {spec!r}")
+    return out

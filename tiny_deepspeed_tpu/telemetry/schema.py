@@ -64,7 +64,18 @@ _NUM = (int, float)
 #      fleet_dispatch / fleet_failover / fleet_replicas_live router
 #      gauges — all emitted only by fleet/disagg runs, so single-engine
 #      files stay byte-compatible with v7 readers
-SCHEMA_VERSION = 8
+#   9: + multi-tenant serving & shared-prefix KV reuse: request records
+#      carry `tenant` (the submitting tenant id, when tagged) and, on
+#      prefix-cache engines, prefix_blocks / prefix_tokens (blocks
+#      aliased from the radix tree / prompt tokens whose prefill the
+#      aliases avoided, cumulative over the request's admissions);
+#      fault records of the chaos `tenant_flood` kind ride the
+#      existing fields; the serve_prefix_* gauges (hit rate, blocks
+#      aliased, tokens avoided, cached blocks, refcount-measured pool
+#      bytes saved) and serve_tenants_active — all emitted only by
+#      prefix/tenant-configured engines, so plain serving files stay
+#      byte-compatible with v8 readers
+SCHEMA_VERSION = 9
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -231,6 +242,18 @@ META_FIELDS: Dict[str, tuple] = {
     # request/tick/fault record — one metrics stream carries a whole
     # fleet, and serve_report.py's Fleet section groups by it
     "replica_id": int,
+    # multi-tenant serving (schema v9): the submitting tenant id on
+    # request records of tagged traffic — serve_report.py's Tenancy
+    # table groups by it, and the tenant_flood isolation A/B reads the
+    # well-behaved tenant's p99 off it
+    "tenant": str,
+    # shared-prefix KV reuse (schema v9, prefix-cache engines only):
+    # blocks aliased from the radix tree into this request's block
+    # table and the prompt tokens whose prefill those aliases avoided
+    # — cumulative over the request's admissions (a preemption resume
+    # that re-hits the cache counts again: it avoided another prefill)
+    "prefix_blocks": int,
+    "prefix_tokens": int,
     # disaggregated serving (schema v8): the prefill->decode paged-KV
     # handoff this request paid — MEASURED payload bytes (pool resting
     # dtype + scales, so quantized pools show the same 4x compression
@@ -445,4 +468,26 @@ GAUGES: Dict[str, str] = {
     "fleet_replicas_live": "live replicas behind the router at the "
                            "last dispatch/tick — the fleet's serving "
                            "capacity denominator",
+    "serve_prefix_hit_rate": "shared-prefix cache: prompt tokens "
+                             "aliased from the radix tree / prompt "
+                             "tokens admitted, engine lifetime — the "
+                             "fraction of prefill work the cache "
+                             "avoided",
+    "serve_prefix_blocks_aliased": "shared-prefix cache: pool blocks "
+                                   "aliased into admissions' block "
+                                   "tables instead of re-prefilled, "
+                                   "cumulative",
+    "serve_prefix_tokens_avoided": "shared-prefix cache: prompt "
+                                   "tokens whose prefill an alias "
+                                   "replaced, cumulative",
+    "serve_prefix_cached_blocks": "blocks the radix tree currently "
+                                  "holds warm (one refcount each; "
+                                  "yielded LRU under pool pressure)",
+    "serve_prefix_pool_saved_bytes": "pool bytes sharing saves right "
+                                     "now, measured from refcounts: "
+                                     "every holder beyond a block's "
+                                     "first would otherwise need its "
+                                     "own physical block",
+    "serve_tenants_active": "distinct tenants with queued or active "
+                            "requests at the last scheduler tick",
 }
