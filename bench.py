@@ -91,6 +91,17 @@ def _config_fingerprint(env=None) -> str:
         "prefix_pool": env.get("BENCH_PREFIX_POOL", ""),
         "prefix_len": env.get("BENCH_PREFIX_LEN", ""),
         "prefix_zipf": env.get("BENCH_PREFIX_ZIPF", ""),
+        # kernel / e2e-autotune knobs: a record measured with the Pallas
+        # paged-attention kernel, the fp8 matmul arm, or a tuned plan
+        # applied can never replay as (or overwrite) another arm's —
+        # BENCH_TUNE_PLAN carries the RESOLVED plan hash (set by the
+        # code that consumes a persisted plan, not only by hand), so
+        # two runs under different tuned plans fingerprint apart even
+        # with every other knob equal
+        "paged_kernel": env.get("BENCH_PAGED_KERNEL", ""),
+        "fp8_matmul": env.get("BENCH_FP8_MATMUL", ""),
+        "tune_e2e": env.get("BENCH_TUNE_E2E", ""),
+        "tune_plan": env.get("BENCH_TUNE_PLAN", ""),
     }, sort_keys=True)
 
 
@@ -256,11 +267,13 @@ def _retry_or_diagnose(exc: BaseException) -> None:
     # round's healthy number
     if (os.environ.get("BENCH_DECODE") or os.environ.get("BENCH_SERVE")
             or os.environ.get("BENCH_SPEC")
-            or os.environ.get("BENCH_PREFIX")):
-        # decode/serve/spec/prefix modes have their own metric names and
-        # no last-good cache (the cache holds TRAIN throughput —
+            or os.environ.get("BENCH_PREFIX")
+            or os.environ.get("BENCH_TUNE_E2E")):
+        # decode/serve/spec/prefix/tune modes have their own metric names
+        # and no last-good cache (the cache holds TRAIN throughput —
         # replaying it here would report a train number as a serve one)
-        mode = ("prefix" if os.environ.get("BENCH_PREFIX")
+        mode = ("tune_e2e" if os.environ.get("BENCH_TUNE_E2E")
+                else "prefix" if os.environ.get("BENCH_PREFIX")
                 else "spec" if os.environ.get("BENCH_SPEC")
                 else "serve" if os.environ.get("BENCH_SERVE")
                 else "decode")
@@ -766,12 +779,64 @@ def run_decode(model_name: str, b=8, prompt_t=128, new_tokens=256):
     }
 
 
+def _kernel_stamp(paged_mode=None) -> dict:
+    """The RESOLVED kernel-arm choices for this invocation — stamped
+    into serve/spec/tune extras so a record can never claim a kernel it
+    fell back from: the paged-attention mode and what it dispatches on
+    this backend, the fp8 matmul mode, and the applied tuned-plan hash
+    (empty when no plan was consumed)."""
+    from tiny_deepspeed_tpu.ops.matmul_fp8 import fp8_matmul_mode
+    from tiny_deepspeed_tpu.ops.paged_attn_pallas import (
+        effective_paged_kernel, paged_kernel_forced,
+    )
+    mode = (paged_mode if paged_mode is not None
+            else os.environ.get("BENCH_PAGED_KERNEL", "auto"))
+    with paged_kernel_forced(mode):
+        eff = effective_paged_kernel()
+    return {
+        "paged_kernel": mode,
+        "paged_kernel_effective": eff,
+        "fp8_matmul": fp8_matmul_mode(),
+        "tune_plan": os.environ.get("BENCH_TUNE_PLAN", ""),
+    }
+
+
+def _tune_cache_path() -> str:
+    return os.environ.get("BENCH_TUNE_CACHE", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "artifacts", "autotune_cache.json"))
+
+
+def _mesh_desc():
+    import jax
+    return f"{jax.device_count()}dev", jax.default_backend()
+
+
+def _tuned_plan(model_name: str):
+    """The persisted tune_e2e plan entry for (model, mesh, backend), or
+    None.  Consumers that take a knob from it must export the plan hash
+    into BENCH_TUNE_PLAN so the fingerprint reflects the plan."""
+    from tiny_deepspeed_tpu.autotuner import RuntimeAutoTuner, plan_key
+    path = _tune_cache_path()
+    if not os.path.exists(path):
+        return None
+    tuner = RuntimeAutoTuner()
+    try:
+        tuner.load(path)
+    except (OSError, ValueError):
+        return None
+    mesh, backend = _mesh_desc()
+    return tuner.get_plan(plan_key(model_name, mesh, backend))
+
+
 def run_serve(model_name: str, b=None, t=None):
     """Serving-tier throughput: continuous batching over the paged KV
     pool under the synthetic arrivals driver (serving/driver.py — the
     same code path scripts/serve_bench.py and the tests drive), tokens/s
     with p50/p99 per-token latency and batch occupancy in extra.
-    BENCH_SERVE=1 selects this mode.
+    BENCH_SERVE=1 selects this mode.  BENCH_PAGED_KERNEL=auto|on|off is
+    the Pallas paged-attention A/B arm (ServeConfig.paged_kernel);
+    extra.kernels stamps the RESOLVED choices.
 
     Fingerprint/staleness conventions: the BENCH_SERVE* knobs are part
     of `_config_fingerprint`, so a serve invocation can neither replay
@@ -813,6 +878,7 @@ def run_serve(model_name: str, b=None, t=None):
         max_active=max_active, num_blocks=max_active * worst + 1,
         block_tokens=bt, quant=quant, temperature=0.0,
         max_seq_tokens=min(worst * bt, cfg.block_size),
+        paged_kernel=os.environ.get("BENCH_PAGED_KERNEL", "auto"),
     )
 
     eng = ServingEngine(model, params, serve_cfg)
@@ -848,8 +914,30 @@ def run_serve(model_name: str, b=None, t=None):
             # terminal outcomes (all "ok" on this fault-free record;
             # anything else means the bench itself mis-served)
             "status_counts": res["status_counts"],
+            # resolved kernel arms: the record can never claim a
+            # kernel choice that fell back on this backend
+            "kernels": _kernel_stamp(serve_cfg.paged_kernel),
         },
     }
+
+
+def resolve_spec_k(model_name: str, env=None, plan_entry=None):
+    """(spec_k, source) for a spec serving run: BENCH_SPEC_K when set
+    ("env"), else the persisted tune_e2e plan's spec_k ("plan"), else
+    the hand-set default 4 ("default").  Consuming a plan knob exports
+    the plan's hash into BENCH_TUNE_PLAN so `_config_fingerprint`
+    distinguishes runs under different tuned plans — the round-trip
+    tests/test_paged_kernel.py pins."""
+    env = os.environ if env is None else env
+    raw = env.get("BENCH_SPEC_K")
+    if raw:
+        return int(raw), "env"
+    if plan_entry is None:
+        plan_entry = _tuned_plan(model_name)
+    if plan_entry and "spec_k" in plan_entry.get("plan", {}):
+        env.setdefault("BENCH_TUNE_PLAN", plan_entry["hash"])
+        return int(plan_entry["plan"]["spec_k"]), "plan"
+    return 4, "default"
 
 
 def run_spec_ab(model_name: str):
@@ -891,7 +979,13 @@ def run_spec_ab(model_name: str):
     max_new = int(os.environ.get("BENCH_SPEC_NEW_TOKENS", "48"))
     max_active = int(os.environ.get("BENCH_SPEC_ACTIVE", "4"))
     drafter = os.environ.get("BENCH_SPEC_DRAFT", "ngram")
-    spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    # spec_k resolution: explicit env > the persisted tune_e2e plan for
+    # this (model, mesh, backend) > the hand-set default.  A plan-chosen
+    # spec_k exports the plan hash into BENCH_TUNE_PLAN FIRST, so the
+    # fingerprint (and any cached-record matching) reflects the tuned
+    # value — before this, spec_k was only ever hand-set and a tuned
+    # choice had no path into the serving config
+    spec_k, spec_k_source = resolve_spec_k(model_name)
     prompt_mode = os.environ.get("BENCH_SPEC_PROMPT", "repeat")
     plen = int(os.environ.get("BENCH_SPEC_PROMPT_TOKENS", "32"))
     train_steps = int(os.environ.get("BENCH_SPEC_TRAIN_STEPS", "400"))
@@ -942,6 +1036,7 @@ def run_spec_ab(model_name: str):
         max_active=max_active, num_blocks=max_active * worst + 1,
         block_tokens=bt, temperature=0.0,
         max_seq_tokens=min(worst * bt, cfg.block_size),
+        paged_kernel=os.environ.get("BENCH_PAGED_KERNEL", "auto"),
     )
 
     passes = int(os.environ.get("BENCH_SPEC_PASSES", "3"))
@@ -998,6 +1093,8 @@ def run_spec_ab(model_name: str):
         "unit": "tokens/s",
         "extra": {
             "drafter": drafter, "spec_k": spec_k,
+            "spec_k_source": spec_k_source,
+            "kernels": _kernel_stamp(serve_kw["paged_kernel"]),
             "prompt_mode": prompt_mode, "requests": n_req,
             "prompt_tokens": plen, "max_new_tokens": max_new,
             "max_active": max_active,
@@ -1126,6 +1223,195 @@ def run_prefix_ab(model_name: str):
         },
     }
     return rec
+
+
+def _ratio(num, den):
+    """round(num/den, 3), or None when either side is None (a failed
+    tune_e2e baseline records score None, not a number)."""
+    if num is None or den is None:
+        return None
+    return round(num / max(den, 1e-9), 3)
+
+
+def run_tune_e2e(model_name: str):
+    """ONE autotune over the whole knob space against END-TO-END
+    objectives (BENCH_TUNE_E2E=1): greedy coordinate descent
+    (autotuner.tune_e2e) over {scan_unroll, fp8 matmul, flash kernel
+    blocks} against the MEASURED training step time, and over {spec_k,
+    paged-attention kernel arm} against the MEASURED serving committed
+    tok/s — closing the standalone-timing gap the per-op tuner has been
+    caught in twice (adamw_pallas, the xent chunk ladder).  The winning
+    joint plan persists per (model, mesh, backend) in the AOT autotune
+    cache (BENCH_TUNE_CACHE, default artifacts/autotune_cache.json);
+    later invocations consume it (run_spec_ab's spec_k resolution) with
+    the plan hash exported into the fingerprint.  The record carries
+    the full A/B evidence: default-plan and tuned-plan scores for both
+    objectives plus every trial.  Like the other serve-family modes it
+    keeps no last-good cache."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tiny_deepspeed_tpu import AdamW, SingleDevice
+    from tiny_deepspeed_tpu.autotuner import (
+        RuntimeAutoTuner, plan_hash, plan_key, tune_e2e,
+    )
+    from tiny_deepspeed_tpu.models import ALL_PRESETS, build_model
+    from tiny_deepspeed_tpu.ops import matmul_fp8
+    from tiny_deepspeed_tpu.ops.attention_pallas import (
+        FLASH_VARIANTS, promote_flash_variant,
+    )
+    from tiny_deepspeed_tpu.ops.dispatch import kernel_target
+    from tiny_deepspeed_tpu.serving import ServeConfig, ServingEngine
+    from tiny_deepspeed_tpu.serving.driver import Arrival, run_trace
+
+    b = int(os.environ.get("BENCH_TUNE_BATCH", "4"))
+    base = ALL_PRESETS[model_name]
+    t = min(int(os.environ.get("BENCH_TUNE_SEQ", "256")), base.block_size)
+    iters = int(os.environ.get("BENCH_TUNE_ITERS", "8"))
+
+    # -- training objective: measured step seconds -------------------------
+    train_space = {
+        "scan_unroll": [base.scan_unroll, True],
+        "fp8_matmul": ["off", "on"],
+    }
+    if kernel_target() == "tpu":
+        # kernel block sizes: whole-step A/B per flash variant (the
+        # promote seam), not standalone kernel timings
+        train_space["flash_block"] = [f.__name__ for f in FLASH_VARIANTS[:3]]
+
+    # restore the PROCESS-ENTRY fp8 mode after every trial (a
+    # BENCH_FP8_MATMUL=on invocation must not have its mode clobbered
+    # to "off" by the search — the fingerprint still claims "on")
+    fp8_entry_mode = matmul_fp8.fp8_matmul_mode()
+
+    def measure_train(plan):
+        cfg = _dc.replace(base, scan_unroll=plan["scan_unroll"])
+        if "flash_block" in plan:
+            promote_flash_variant(plan["flash_block"])
+        matmul_fp8.set_fp8_matmul(plan["fp8_matmul"])
+        try:
+            eng = SingleDevice(build_model(cfg), AdamW(lr=1e-4))
+            state = eng.init(jax.random.PRNGKey(0))
+            idx = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0,
+                                     cfg.vocab_size, jnp.int32)
+            step_s, _ = measure(eng, state, (idx, idx), warmup=2,
+                                iters=iters)
+            return step_s
+        finally:
+            matmul_fp8.set_fp8_matmul(fp8_entry_mode)
+
+    train_plan, train_s, train_trials = tune_e2e(
+        measure_train, train_space, objective="min")
+    if "flash_block" in train_plan:
+        # coordinate descent leaves FLASH_VARIANTS ordered by the LAST
+        # trial measured — re-promote the WINNER so the serve phase and
+        # everything after runs the plan, not an arbitrary leftover
+        promote_flash_variant(train_plan["flash_block"])
+
+    # -- serving objective: measured committed tokens/s --------------------
+    model = build_model(_dc.replace(base, remat=False))
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    n_req = int(os.environ.get("BENCH_TUNE_REQUESTS", "6"))
+    max_new = int(os.environ.get("BENCH_TUNE_NEW_TOKENS", "24"))
+    plen = 16
+    rng = np.random.default_rng(2)
+    prompts = []
+    for _ in range(n_req):  # repeat-motif prompts: the ngram regime
+        motif = rng.integers(0, base.vocab_size, size=4)
+        prompts.append(np.tile(motif, -(-plen // 4))[:plen].tolist())
+    bt = 8
+    worst = -(-(plen + max_new) // bt)
+    serve_kw = dict(
+        max_active=4, num_blocks=4 * worst + 1, block_tokens=bt,
+        temperature=0.0,
+        max_seq_tokens=min(worst * bt, base.block_size),
+    )
+    serve_space = {"spec_k": [4, 2, 8]}
+    # the kernel A/B arm exists only where "off" differs from "auto"
+    # (TPU targets); on the CPU mesh auto already IS the XLA path
+    serve_space["paged_kernel"] = (
+        ["auto", "off"] if kernel_target() == "tpu" else ["auto"])
+
+    def measure_serve(plan):
+        eng = ServingEngine(model, params, ServeConfig(
+            **serve_kw, spec_draft="ngram", spec_k=plan["spec_k"],
+            paged_kernel=plan["paged_kernel"]))
+        run_trace(eng, [Arrival(0.0, prompts[0], 4)], realtime=False)
+        res = run_trace(eng, [Arrival(0.0, p, max_new) for p in prompts],
+                        realtime=False)
+        return res["tokens_per_s"]
+
+    serve_plan, serve_tok, serve_trials = tune_e2e(
+        measure_serve, serve_space, objective="max")
+
+    # -- persist + record --------------------------------------------------
+    plan = {**train_plan, **serve_plan}
+    mesh, backend = _mesh_desc()
+    key = plan_key(model_name, mesh, backend)
+    record = {
+        "train_step_s_default": train_trials[0]["score"],
+        "train_step_s_tuned": train_s,
+        "serve_tok_s_default": serve_trials[0]["score"],
+        "serve_tok_s_tuned": serve_tok,
+        "train_trials": len(train_trials),
+        "serve_trials": len(serve_trials),
+        "batch": b, "seq": t, "backend": backend, "mesh": mesh,
+    }
+    path = _tune_cache_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tuner = RuntimeAutoTuner()
+    if os.path.exists(path):
+        try:
+            tuner.load(path)  # other configs' winners/plans survive
+        except (OSError, ValueError):
+            pass
+    tuner.store_plan(key, plan, record)
+    tuner.save(path)
+    # the produced plan governs THIS record's fingerprint too
+    os.environ["BENCH_TUNE_PLAN"] = plan_hash(plan)
+
+    # autotune decisions as run_meta records (the telemetry-path
+    # satellite applied to the e2e tuner's own output)
+    side = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "artifacts", "bench_tune_e2e.jsonl")
+    try:
+        from tiny_deepspeed_tpu.telemetry.schema import SCHEMA_VERSION
+        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
+        if os.path.exists(side):
+            os.remove(side)
+        with MetricsLogger(side, stdout=False) as ml:
+            ml.log_meta(schema_version=SCHEMA_VERSION, model=model_name,
+                        autotune={
+                            "event": "tune_e2e", "plan": plan,
+                            "plan_hash": plan_hash(plan), "record": record,
+                            "train_trials": train_trials,
+                            "serve_trials": serve_trials,
+                        })
+    except OSError:
+        pass
+
+    return {
+        "metric": f"{model_name}_tune_e2e_tokens_per_sec",
+        "value": serve_tok,
+        "unit": "tokens/s",
+        "extra": {
+            "plan": plan, "plan_hash": plan_hash(plan), "plan_key": key,
+            "cache_path": os.path.relpath(
+                path, os.path.dirname(os.path.abspath(__file__))),
+            **record,
+            # None-safe: a failed DEFAULT measurement records score None
+            # (tune_e2e's infeasible marker) — the speedup is then
+            # unknown, not a crash after the whole search already ran
+            "train_speedup": _ratio(record["train_step_s_default"],
+                                    record["train_step_s_tuned"]),
+            "serve_speedup": _ratio(record["serve_tok_s_tuned"],
+                                    record["serve_tok_s_default"]),
+            "kernels": _kernel_stamp(serve_plan.get("paged_kernel")),
+            "telemetry_jsonl": "artifacts/bench_tune_e2e.jsonl",
+        },
+    }
 
 
 def _round_number(path: str) -> int:
@@ -1264,7 +1550,18 @@ def main():
     model_name = os.environ.get("BENCH_MODEL", "gpt2-124m")
     b = os.environ.get("BENCH_BATCH")
     t = int(os.environ.get("BENCH_SEQ", "1024"))
+    if os.environ.get("BENCH_FP8_MATMUL"):
+        # fp8 matmul arm (ops/matmul_fp8.py): applies to every mode's
+        # traces in this process — run_one's training step, the serve
+        # family's decode programs, and the fused-xent head
+        from tiny_deepspeed_tpu.ops.matmul_fp8 import set_fp8_matmul
+        set_fp8_matmul(os.environ["BENCH_FP8_MATMUL"])
     try:
+        if os.environ.get("BENCH_TUNE_E2E"):
+            rec = run_tune_e2e(model_name)
+            rec["vs_baseline"] = rec["extra"]["serve_speedup"] or 1.0
+            print(json.dumps(_stamp_probe(rec)))
+            return
         if os.environ.get("BENCH_PREFIX"):
             rec = run_prefix_ab(model_name)
             rec["vs_baseline"] = rec["extra"]["speedup"]

@@ -157,4 +157,24 @@ log "     adjust BENCH_GATHER_GROUPS to the fast-link group size)"
 timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_GATHER_PREFETCH=2 BENCH_GATHER_QUANT=fp8 BENCH_GATHER_GROUPS=2 python bench.py > "$OUT/bench_gatherpf_fp8_hier.json" 2> "$OUT/bench_gatherpf_fp8_hier.err"
 log "   fp8 K=2 2-hop rc=$? $(cat "$OUT/bench_gatherpf_fp8_hier.json" 2>/dev/null | head -c 160)"
 
+log "18. e2e autotune + kernel A/B (round-14: tune_e2e joint knob search,"
+log "    Pallas paged-attention serve arms, fp8 matmul train arm at 124M;"
+log "    the tuned plan persists in artifacts/autotune_cache.json and the"
+log "    spec bench resolves spec_k from it)"
+timeout 3000 env BENCH_TUNE_E2E=1 python bench.py > "$OUT/bench_tune_e2e.json" 2> "$OUT/bench_tune_e2e.err"
+log "   tune_e2e rc=$? $(cat "$OUT/bench_tune_e2e.json" 2>/dev/null | head -c 240)"
+for pk in on off; do
+  timeout 2400 env BENCH_SERVE=1 BENCH_PAGED_KERNEL=$pk python bench.py > "$OUT/bench_serve_pk_$pk.json" 2> "$OUT/bench_serve_pk_$pk.err"
+  log "   serve paged_kernel=$pk rc=$? $(cat "$OUT/bench_serve_pk_$pk.json" 2>/dev/null | head -c 160)"
+done
+timeout 2400 env BENCH_SPEC=1 python bench.py > "$OUT/bench_spec_tuned_k.json" 2> "$OUT/bench_spec_tuned_k.err"
+log "   spec (plan-resolved spec_k) rc=$? $(cat "$OUT/bench_spec_tuned_k.json" 2>/dev/null | head -c 160)"
+timeout 2400 env BENCH_FP8_MATMUL=on python bench.py > "$OUT/bench_fp8_matmul.json" 2> "$OUT/bench_fp8_matmul.err"
+log "   fp8 matmul train arm rc=$? $(cat "$OUT/bench_fp8_matmul.json" 2>/dev/null | head -c 160)"
+log "18b. refreshed 1.5B row (kernel-era baseline + fp8 arm)"
+timeout 2400 env BENCH_MODEL=gpt2-1.5b python bench.py > "$OUT/bench_1.5b_refresh.json" 2> "$OUT/bench_1.5b_refresh.err"
+log "   1.5b rc=$? $(cat "$OUT/bench_1.5b_refresh.json" 2>/dev/null | head -c 160)"
+timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_FP8_MATMUL=on python bench.py > "$OUT/bench_1.5b_fp8.json" 2> "$OUT/bench_1.5b_fp8.err"
+log "   1.5b fp8 rc=$? $(cat "$OUT/bench_1.5b_fp8.json" 2>/dev/null | head -c 160)"
+
 log "batch complete; results in $OUT"

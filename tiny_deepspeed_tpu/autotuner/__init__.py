@@ -3,6 +3,14 @@
 
 """Runtime autotuner (parity: reference core/autotuner/__init__.py:3)."""
 
-from .runtime_tuner import RuntimeAutoTuner, get_default_tuner, set_default_tuner
+from .runtime_tuner import (
+    RuntimeAutoTuner,
+    get_default_tuner,
+    plan_hash,
+    plan_key,
+    set_default_tuner,
+    tune_e2e,
+)
 
-__all__ = ["RuntimeAutoTuner", "get_default_tuner", "set_default_tuner"]
+__all__ = ["RuntimeAutoTuner", "get_default_tuner", "set_default_tuner",
+           "tune_e2e", "plan_key", "plan_hash"]

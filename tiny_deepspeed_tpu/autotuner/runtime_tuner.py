@@ -22,6 +22,8 @@ compilation model:
 
 from __future__ import annotations
 
+import hashlib
+import json
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -31,10 +33,17 @@ import numpy as np
 
 
 class RuntimeAutoTuner:
-    def __init__(self, warmup: int = 2, iters: int = 5, verbose: bool = False):
+    def __init__(self, warmup: int = 2, iters: int = 5,
+                 verbose: bool = False, telemetry=None, logger=None):
         self.warmup = warmup
         self.iters = iters
         self.verbose = verbose
+        # diagnostics sinks (attach_diagnostics): decisions become
+        # `run_meta` records on the MetricsLogger and candidate failures
+        # a Telemetry counter + gauge — the bare stderr prints this
+        # class used to emit were invisible to every dashboard
+        self.telemetry = telemetry
+        self.logger = logger
         self.cache: Dict[Tuple, Callable] = {}
         # key -> (candidates, arg signature, static kwargs): requests made
         # from inside a trace, to be timed by resolve_pending()
@@ -84,6 +93,57 @@ class RuntimeAutoTuner:
                            .astype(dtype))
         return tuple(out)
 
+    def attach_diagnostics(self, telemetry=None, logger=None) -> None:
+        """Route tuner diagnostics into the run's observability surface:
+        `telemetry` (a Telemetry registry) receives the
+        autotune_candidate_failures counter/gauge, `logger` (a
+        MetricsLogger) one `run_meta` record per timing decision."""
+        if telemetry is not None:
+            self.telemetry = telemetry
+        if logger is not None:
+            self.logger = logger
+
+    def _diag_failure(self, fn: Callable, exc: BaseException) -> None:
+        """One candidate refused these shapes: count it where dashboards
+        look (an occasional failure is normal — FA2 past its T bound —
+        a climbing counter means a rotten candidate list)."""
+        if self.telemetry is not None:
+            n = self.telemetry.counter("autotune_candidate_failures").inc()
+            self.telemetry.gauge("autotune_candidate_failures", float(n))
+        if self.logger is not None:
+            self.logger.log_meta(
+                kind="run_meta",
+                autotune={"event": "candidate_failed",
+                          "candidate": fn.__name__,
+                          "error": type(exc).__name__},
+            )
+        elif self.verbose:
+            print(f"autotuner: {fn.__name__} failed: {type(exc).__name__}")
+
+    def _diag_decision(self, candidates, times, best: int) -> None:
+        """One timing decision: the ranking becomes a `run_meta` record
+        (and the stderr line only without a logger)."""
+        if self.logger is not None:
+            self.logger.log_meta(
+                kind="run_meta",
+                autotune={
+                    "event": "decision",
+                    "winner": candidates[best].__name__,
+                    "ranking": [
+                        {"candidate": c.__name__,
+                         "us": None if t == float("inf")
+                         else round(t * 1e6, 1)}
+                        for c, t in zip(candidates, times)
+                    ],
+                },
+            )
+        elif self.verbose:
+            ranking = ", ".join(
+                f"{c.__name__}={t * 1e6:.0f}us"
+                for c, t in zip(candidates, times)
+            )
+            print(f"autotuner: {ranking} -> {candidates[best].__name__}")
+
     def _time_one(self, fn: Callable, concrete, static_kwargs) -> float:
         jitted = jax.jit(lambda *xs: fn(*xs, **static_kwargs))
         try:
@@ -99,8 +159,7 @@ class RuntimeAutoTuner:
             np.asarray(jax.tree.leaves(r)[0].ravel()[0:1])
             return (time.perf_counter() - t0) / self.iters
         except Exception as e:  # candidate doesn't support these shapes
-            if self.verbose:
-                print(f"autotuner: {fn.__name__} failed: {type(e).__name__}")
+            self._diag_failure(fn, e)
             return float("inf")
 
     # -- public API --------------------------------------------------------
@@ -150,12 +209,7 @@ class RuntimeAutoTuner:
         best = int(np.argmin(times))
         if times[best] == float("inf"):
             best = 0
-        if self.verbose:
-            ranking = ", ".join(
-                f"{c.__name__}={t * 1e6:.0f}us"
-                for c, t in zip(candidates, times)
-            )
-            print(f"autotuner: {ranking} -> {candidates[best].__name__}")
+        self._diag_decision(candidates, times, best)
         self.cache[key] = candidates[best]
         self.version += 1
         return candidates[best]
@@ -190,11 +244,15 @@ class RuntimeAutoTuner:
     # a stored name against the live candidate list.
 
     def save(self, path: str) -> int:
-        """Write the winner table as JSON; returns entries written.
-        Loaded entries not re-hit this run are preserved (a shared cache
-        file across model configs must not lose the other configs'
-        winners on overwrite)."""
-        import json
+        """Write the winner table (and any end-to-end tuned plans) as
+        JSON; returns winner entries written.  Loaded entries not re-hit
+        this run are preserved (a shared cache file across model configs
+        must not lose the other configs' winners on overwrite).
+
+        Format: the v2 envelope {"version": 2, "winners": {...},
+        "plans": {...}} — `plans` holds `tune_e2e` results keyed by
+        plan_key (model, mesh, backend).  `load` still reads the
+        pre-plan flat {key: winner} files."""
         table = {
             json.dumps(key): name
             for key, name in getattr(self, "_stored", {}).items()
@@ -204,24 +262,153 @@ class RuntimeAutoTuner:
             for key, fn in self.cache.items()
         })
         with open(path, "w", encoding="utf-8") as f:
-            json.dump(table, f, indent=1)
+            json.dump({"version": 2, "winners": table,
+                       "plans": dict(getattr(self, "_plans", {}))},
+                      f, indent=1)
         return len(table)
 
     def load(self, path: str) -> int:
-        """Read a winner table; entries resolve lazily at choose() time
-        (a stored name only applies when it matches one of the live
-        candidates for that key).  Returns entries read."""
-        import json
-
+        """Read a winner table (either format); entries resolve lazily
+        at choose() time (a stored name only applies when it matches one
+        of the live candidates for that key).  Returns entries read."""
         def tuplify(x):
             return tuple(tuplify(i) for i in x) if isinstance(x, list) else x
 
         with open(path, encoding="utf-8") as f:
-            table = json.load(f)
+            data = json.load(f)
+        if isinstance(data, dict) and data.get("version") == 2:
+            table = data.get("winners", {})
+            self._plans = dict(data.get("plans", {}))
+        else:  # legacy flat winner table
+            table = data
         self._stored = {
             tuplify(json.loads(key_s)): name for key_s, name in table.items()
         }
         return len(self._stored)
+
+    # -- end-to-end tuned plans ---------------------------------------------
+    #
+    # Per-op winners above answer "which kernel for this shape"; a PLAN
+    # answers "which knob values for this whole workload": the tune_e2e
+    # search's winning assignment of scan_unroll / fp8 mode / kernel
+    # block sizes / bucket K / prefetch depth / spec_k, measured against
+    # end-to-end objectives (training step time, serving committed
+    # tok/s) rather than standalone op timings.  Plans persist in the
+    # same AOT cache file, keyed per (model, mesh, backend).
+
+    def store_plan(self, key: str, plan: Dict, record: Optional[Dict]
+                   = None) -> str:
+        """Remember `plan` for `key` (use plan_key()); `record` carries
+        the measured A/B evidence.  Returns the plan hash."""
+        plans = getattr(self, "_plans", None)
+        if plans is None:
+            plans = self._plans = {}
+        plans[key] = {"plan": dict(plan), "hash": plan_hash(plan),
+                      "record": dict(record or {})}
+        return plans[key]["hash"]
+
+    def get_plan(self, key: str) -> Optional[Dict]:
+        """The stored plan entry for `key` ({"plan", "hash", "record"}),
+        or None."""
+        return getattr(self, "_plans", {}).get(key)
+
+
+# ---------------------------------------------------------------------------
+# tune_e2e: one search over the whole knob space, end-to-end objectives
+# ---------------------------------------------------------------------------
+#
+# The per-op tuner above times candidates as STANDALONE jits — a proxy
+# that has already been caught lying twice (adamw_pallas: a standalone
+# winner losing in-graph; softmax_xent: the ladder capped at 256 because
+# standalone timing is blind to live-memory pressure).  tune_e2e closes
+# the loop: the caller supplies a `measure(plan) -> float` that runs the
+# REAL objective (a training step, a serving trace) with the plan's knob
+# assignment applied, and the search walks the joint space.
+#
+# The search is greedy coordinate descent from the default assignment
+# (each knob's first value), `rounds` full sweeps: with K knobs of V
+# values it costs O(rounds * K * V) measurements instead of V^K, and for
+# the knob spaces here (scan_unroll x fp8 x blocks x bucket K x prefetch
+# x spec_k) interactions beyond one sweep are second-order — a second
+# round is available where they are not.  Every trial is recorded so
+# the bench JSON can show its work.
+
+
+def plan_key(model: str, mesh: str, backend: str) -> str:
+    """Canonical plan-store key: a plan tuned on one (model, mesh,
+    backend) must never silently apply to another."""
+    return f"{model}|{mesh}|{backend}"
+
+
+def plan_hash(plan: Dict) -> str:
+    """Short stable hash of a knob assignment — stamped into bench
+    fingerprints so cached records from different plans never mix."""
+    s = json.dumps(plan, sort_keys=True, default=str)
+    return hashlib.sha256(s.encode()).hexdigest()[:12]
+
+
+def tune_e2e(measure: Callable[[Dict], float], space: Dict[str, Sequence],
+             *, objective: str = "min", rounds: int = 1,
+             start: Optional[Dict] = None, on_trial=None):
+    """Greedy coordinate-descent search of `space` ({knob: [values...]},
+    first value = the default) against `measure(plan) -> float`.
+    `objective` "min" (step seconds) or "max" (tokens/s).  Returns
+    (best_plan, best_score, trials) where trials is every measured
+    {"plan", "score"} in order (the baseline/default plan is trials[0]).
+    `on_trial(plan, score)` observes each measurement (progress logs).
+    A measure() that raises marks that assignment infeasible (scored
+    worst) rather than aborting the search — a candidate plan that
+    fails to compile must not cost the tuning run."""
+    if objective not in ("min", "max"):
+        raise ValueError(f"objective must be 'min' or 'max': {objective!r}")
+    sign = 1.0 if objective == "min" else -1.0
+    worst = float("inf")
+
+    def same(a, b):
+        # knob values compare by type too: scan_unroll's 1 (scanned)
+        # and True (fully unrolled) are DIFFERENT assignments, but
+        # Python's True == 1
+        return type(a) is type(b) and a == b
+
+    def run(plan):
+        try:
+            s = float(measure(dict(plan)))
+        except Exception:
+            return worst
+        if on_trial is not None:
+            on_trial(dict(plan), s)
+        return sign * s
+
+    best = {k: vs[0] for k, vs in space.items()}
+    if start:
+        best.update({k: v for k, v in start.items() if k in space})
+    trials: List[Dict] = []
+
+    def record(plan, signed):
+        trials.append({"plan": dict(plan),
+                       "score": None if signed == worst else sign * signed})
+
+    best_score = run(best)
+    record(best, best_score)
+    for _ in range(max(1, rounds)):
+        improved = False
+        for knob, values in space.items():
+            for v in values:
+                if same(v, best[knob]):
+                    continue
+                cand = dict(best, **{knob: v})
+                s = run(cand)
+                record(cand, s)
+                if s < best_score:
+                    best, best_score, improved = cand, s, True
+        if not improved:
+            break
+    if best_score == worst:
+        raise RuntimeError(
+            "tune_e2e: every candidate plan failed to measure — the "
+            "objective itself is broken, not the knob space"
+        )
+    return best, sign * best_score, trials
 
 
 _default_tuner: Optional[RuntimeAutoTuner] = None

@@ -585,6 +585,25 @@ class GPT2Model:
     # position (vector `pos`).  The attention itself is the existing GQA
     # `_decode_attention`; only the cache read/write changes.
 
+    def _paged_attention(self, q, view, l, page, span_kv=None):
+        """ONE dispatch seam for pool-panel attention, shared by the
+        paged decode and spec-verify/suffix-prefill paths of every
+        family: the Pallas fused gather+attention kernel when the gate
+        says so (ops/paged_attn_pallas.use_paged_kernel — TPU targets,
+        or forced via ServeConfig.paged_kernel), else the XLA reference
+        (materialized `paged_panel` + `_decode_attention` /
+        `_span_attention`).  q (S, Hq, K1, Dh); span_kv = (sk, sv) span
+        K/V switches to the span-verify mask."""
+        from ..ops.paged_attn_pallas import paged_attention, use_paged_kernel
+        if use_paged_kernel():
+            return paged_attention(q, view, page, l, span_kv=span_kv)
+        from ..serving.pool import paged_panel
+        ck, cv = paged_panel(view, l, page, self.config.compute_dtype)
+        if span_kv is None:
+            return self._decode_attention(q, ck, cv, page.pos)
+        sk, sv = span_kv
+        return self._span_attention(q, ck, cv, sk, sv, page.pos)
+
     def _paged_attn_decode(self, x, bp, view, l, page):
         """Attention half of one paged decode step.  x: (S, 1, D); view:
         serving.pool.KVPoolView (the pool arrays, riding the layer-scan
@@ -599,12 +618,11 @@ class GPT2Model:
         def heads1(z):
             return z.reshape(s, 1, c.n_head, c.head_dim).swapaxes(1, 2)
 
-        from ..serving.pool import paged_append, paged_panel
+        from ..serving.pool import paged_append
         view = paged_append(
             view, heads1(k)[:, :, 0], heads1(v)[:, :, 0], l, page
         )
-        ck, cv = paged_panel(view, l, page, c.compute_dtype)
-        y = self._decode_attention(heads1(q), ck, cv, page.pos)
+        y = self._paged_attention(heads1(q), view, l, page)
         y = y.swapaxes(1, 2).reshape(s, 1, c.n_embd)
         y = linear(y, self._bw(bp, "attn.proj.w"), bp.get("attn.proj.b"))
         return x + y, view
@@ -711,10 +729,9 @@ class GPT2Model:
         def heads(z):
             return z.reshape(s, k1, c.n_head, c.head_dim).swapaxes(1, 2)
 
-        from ..serving.pool import paged_panel
         kh, vh = heads(k), heads(v)
-        ck, cv = paged_panel(view, l, page, c.compute_dtype)
-        y = self._span_attention(heads(q), ck, cv, kh, vh, page.pos)
+        y = self._paged_attention(heads(q), view, l, page,
+                                  span_kv=(kh, vh))
         y = y.swapaxes(1, 2).reshape(s, k1, c.n_embd)
         y = linear(y, self._bw(bp, "attn.proj.w"), bp.get("attn.proj.b"))
         return x + y, (kh, vh)

@@ -302,10 +302,9 @@ class LlamaModel(GPT2Model):
         v = v.reshape(b, 1, c.kv_heads, hd).swapaxes(1, 2)
         q = rope_at(q, page.pos, c.rope_theta)
         k = rope_at(k, page.pos, c.rope_theta)
-        from ..serving.pool import paged_append, paged_panel
+        from ..serving.pool import paged_append
         view = paged_append(view, k[:, :, 0], v[:, :, 0], l, page)
-        ck, cv = paged_panel(view, l, page, c.compute_dtype)
-        y = self._decode_attention(q, ck, cv, page.pos)
+        y = self._paged_attention(q, view, l, page)
         y = y.swapaxes(1, 2).reshape(b, 1, c.n_embd)
         return x + linear(y, self._bw(bp, "attn.o.w"), None), view
 
@@ -326,9 +325,7 @@ class LlamaModel(GPT2Model):
         positions = page.pos[:, None] + jnp.arange(k1)[None, :]
         q = rope_span(q, positions, c.rope_theta)
         k = rope_span(k, positions, c.rope_theta)
-        from ..serving.pool import paged_panel
-        ck, cv = paged_panel(view, l, page, c.compute_dtype)
-        y = self._span_attention(q, ck, cv, k, v, page.pos)
+        y = self._paged_attention(q, view, l, page, span_kv=(k, v))
         y = y.swapaxes(1, 2).reshape(s, k1, c.n_embd)
         return x + linear(y, self._bw(bp, "attn.o.w"), None), (k, v)
 

@@ -45,11 +45,23 @@ def linear_forward(x, w, b=None, tuner=None):
     "Add more functions here"): direct batched dot_general vs flatten-to-2D
     (one (B*T, in) @ (in, out) matmul — a different tiling problem for the
     Mosaic scheduler).  Winner picked per (shape, dtype) by the installed
-    runtime tuner; candidate[0] without one."""
+    runtime tuner; candidate[0] without one.
+
+    fp8 (ops/matmul_fp8.py): mode "candidate" adds the e4m3 forward
+    matmul to the tuner list (it wins only if measured faster); "on"
+    forces it — the BENCH_FP8_MATMUL A/B arm.  "off" (default) takes
+    the exact pre-fp8 path: same candidates, same trace, byte-identical
+    HLO (pinned)."""
+    from .matmul_fp8 import _fwd_fp8, fp8_matmul_mode
+    mode = fp8_matmul_mode()
+    if mode == "on":
+        return _fwd_fp8(x, w, b)
     if tuner is None:
         from ..autotuner import get_default_tuner
         tuner = get_default_tuner()
-    impl = tuner.choose(_CANDIDATES_FWD, (x, w, b)) if tuner else _fwd_xla
+    cands = (_CANDIDATES_FWD if mode == "off"
+             else _CANDIDATES_FWD + [_fwd_fp8])
+    impl = tuner.choose(cands, (x, w, b)) if tuner else cands[0]
     return impl(x, w, b)
 
 

@@ -68,6 +68,18 @@ def _pick_chunk(t: int, want: int) -> int:
     return t
 
 
+def _head_logits(xc, w):
+    """One chunk's lm_head matmul in f32 — or the e4m3 fp8 matmul when
+    ops/matmul_fp8 is forced "on" (the BENCH_FP8_MATMUL arm covers the
+    fused head too; trace-time gate, so "off" stays byte-identical)."""
+    from .matmul_fp8 import fp8_matmul, fp8_matmul_mode
+    if fp8_matmul_mode() == "on":
+        return fp8_matmul(xc, w)
+    return jnp.einsum(
+        "btd,dv->btv", xc, w, preferred_element_type=jnp.float32
+    )
+
+
 def _chunk_iter_fwd(x, w, targets, chunk):
     """Scan over sequence chunks: returns (loss_sum f32 scalar, logz (B,T))."""
     b, t, _ = x.shape
@@ -77,9 +89,7 @@ def _chunk_iter_fwd(x, w, targets, chunk):
         start = ci * chunk
         xc = jax.lax.dynamic_slice_in_dim(x, start, chunk, axis=1)
         tc = jax.lax.dynamic_slice_in_dim(targets, start, chunk, axis=1)
-        logits = jnp.einsum(
-            "btd,dv->btv", xc, w, preferred_element_type=jnp.float32
-        )
+        logits = _head_logits(xc, w)
         logz = jax.scipy.special.logsumexp(logits, axis=-1)  # (B, chunk)
         gold = jnp.take_along_axis(
             logits, tc[..., None], axis=-1
@@ -123,9 +133,9 @@ def _make_flx_variant(want: int, name: str):
             xc = jax.lax.dynamic_slice_in_dim(x, start, chunk, axis=1)
             tc = jax.lax.dynamic_slice_in_dim(targets, start, chunk, axis=1)
             lzc = jax.lax.dynamic_slice_in_dim(logz, start, chunk, axis=1)
-            logits = jnp.einsum(
-                "btd,dv->btv", xc, w, preferred_element_type=jnp.float32
-            )
+            # backward recompute must use the SAME logits the forward
+            # saw — including the fp8 arm's quantization
+            logits = _head_logits(xc, w)
             p = jnp.exp(logits - lzc[..., None])
             vocab = jax.lax.broadcasted_iota(jnp.int32, p.shape, 2)
             p = jnp.where(vocab == tc[..., None], p - 1.0, p) * scale

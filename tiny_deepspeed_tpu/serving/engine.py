@@ -218,6 +218,15 @@ class ServeConfig:
     # pressure.  Does not compose with spec_draft (the suffix prefill
     # and the draft span both own the span path — refused loudly).
     prefix_cache: bool = False
+    # paged-attention kernel dispatch (ops/paged_attn_pallas.py):
+    # "auto" (default) runs the Pallas fused block-table-gather kernel
+    # on TPU kernel targets and the XLA materialized-panel path
+    # elsewhere; "on"/"off" force one arm — "off" is the byte-identical
+    # pre-kernel program (the A/B baseline), "on" on a CPU mesh needs
+    # the kernel's interpret mode (tests).  Applied at trace time to
+    # every program this engine compiles (decode, spec verify, suffix
+    # prefill), scoped so sibling engines' choices never mix.
+    paged_kernel: str = "auto"
     # multi-tenant serving (serving/tenancy.py): {tenant: TenantPolicy}
     # swaps FIFO admission for weighted-fair stride scheduling with
     # per-tenant token budgets, door watermarks, and SLO-class default
@@ -474,6 +483,36 @@ class ServingEngine:
         # consumed in-program — it leaves this None)
         self.last_logits = None
 
+        from ..ops.paged_attn_pallas import (
+            PAGED_KERNEL_MODES, paged_kernel_forced,
+        )
+        if config.paged_kernel not in PAGED_KERNEL_MODES:
+            raise ValueError(
+                f"paged_kernel={config.paged_kernel!r} must be one of "
+                f"{PAGED_KERNEL_MODES}"
+            )
+
+        def _kwrap(fn):
+            """Bracket a compiled program's CALLS with this engine's
+            paged-kernel mode: jit traces lazily at first call, so the
+            trace-time gate reads the right mode, and later (cached)
+            calls pay one no-op context enter.  "auto" skips the
+            wrapper entirely — the default engine's call path (and its
+            programs) stay byte-identical to the pre-kernel tier.
+            Forced windows hold the module's mode lock, so two FORCED
+            engines on parallel fleet threads serialize their calls
+            instead of racing the trace-time gate; an "auto" engine
+            lazily tracing a fresh shape bucket during a sibling's
+            forced window remains a (documented) mixed-fleet hazard —
+            don't mix forced and auto replicas in one parallel fleet."""
+            if config.paged_kernel == "auto":
+                return fn
+
+            def call(*a, **kw):
+                with paged_kernel_forced(config.paged_kernel):
+                    return fn(*a, **kw)
+            return call
+
         bt = config.block_tokens
         temp, top_k = config.temperature, config.top_k
         base_key = jax.random.PRNGKey(config.seed)
@@ -506,8 +545,9 @@ class ServingEngine:
 
         # the pool view is DONATED through both programs: each step
         # aliases the pool buffers instead of copying the whole pool
-        self._decode_fn = jax.jit(decode_step, donate_argnums=(2,))
-        self._prefill_fn = jax.jit(prefill_step, donate_argnums=(5,))
+        self._decode_fn = _kwrap(jax.jit(decode_step, donate_argnums=(2,)))
+        self._prefill_fn = _kwrap(
+            jax.jit(prefill_step, donate_argnums=(5,)))
         # "h.*" compute-dtype cast once — params are frozen while serving
         self._stacked = jax.jit(model.stacked_compute_params)(params)
         # shared-prefix suffix prefill: when admission aliased m full
@@ -539,8 +579,8 @@ class ServingEngine:
                                          count, bt)
                 return nxt, view
 
-            self._prefill_suffix_fn = jax.jit(prefill_suffix_step,
-                                              donate_argnums=(7,))
+            self._prefill_suffix_fn = _kwrap(
+                jax.jit(prefill_suffix_step, donate_argnums=(7,)))
         else:
             self._prefill_suffix_fn = None
         # speculative decoding: the drafter + ONE compiled verify
@@ -552,6 +592,17 @@ class ServingEngine:
             from .spec import SpecDecoder
             self._spec = SpecDecoder(model, params, config, base_key,
                                      max_seq=self.max_seq)
+            # a forced paged-kernel mode must cover EVERY compiled
+            # program on the spec path, not just the engine's own: the
+            # verify span program, and a model drafter's paged
+            # prefill/rollout jits (they ride the same paged attention
+            # and trace just as lazily) — otherwise a forced-"off"
+            # A/B arm would still run the kernel inside the drafter
+            self._spec._verify = _kwrap(self._spec._verify)
+            for prog in ("_rollout", "_prefill"):
+                if hasattr(self._spec.drafter, prog):
+                    setattr(self._spec.drafter, prog,
+                            _kwrap(getattr(self._spec.drafter, prog)))
             # the span horizon: growth/admission must own blocks out to
             # pos + spec_k so accepted drafts' K/V always land in-table
             self._span_k = config.spec_k
@@ -571,8 +622,8 @@ class ServingEngine:
                                           nprod, temp, top_k)
                 return nxt, view
 
-            self._prefill_fn = jax.jit(prefill_step_spec,
-                                       donate_argnums=(5,))
+            self._prefill_fn = _kwrap(
+                jax.jit(prefill_step_spec, donate_argnums=(5,)))
         else:
             self._spec = None
             self._span_k = 0

@@ -110,6 +110,10 @@ class SpecDecoder:
                                      count, bt)
             return acc, final, bad, view
 
+        # NOTE: a forced ServeConfig.paged_kernel mode is applied by the
+        # ENGINE, which wraps this program (and a model drafter's) with
+        # the same _kwrap bracketing as its own decode/prefill jits —
+        # one copy of the discipline, in one place (engine.__init__)
         self._verify = jax.jit(verify_step, donate_argnums=(2,))
 
     def describe(self) -> str:

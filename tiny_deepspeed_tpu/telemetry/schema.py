@@ -75,7 +75,15 @@ _NUM = (int, float)
 #      bytes saved) and serve_tenants_active — all emitted only by
 #      prefix/tenant-configured engines, so plain serving files stay
 #      byte-compatible with v8 readers
-SCHEMA_VERSION = 9
+#  10: + kernels & end-to-end autotuning: run_meta records may carry
+#      `autotune` (a RuntimeAutoTuner decision/failure — candidate
+#      ranking with measured microseconds, or a refused candidate —
+#      and bench's tune_e2e plan summary), and the
+#      autotune_candidate_failures gauge mirrors the counter of
+#      candidates that refused their shapes — emitted only when tuner
+#      diagnostics are attached, so tuner-less files stay
+#      byte-compatible with v9 readers
+SCHEMA_VERSION = 10
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -174,6 +182,11 @@ META_FIELDS: Dict[str, tuple] = {
     "grad_comm": dict,
     "comm_error": str,
     "aot": dict,
+    # autotuner diagnostics (autotuner/runtime_tuner.py): one per
+    # timing decision / refused candidate, and bench's tune_e2e plan
+    # summary — the stderr prints these replaced were invisible to
+    # every dashboard
+    "autotune": dict,
     # registry snapshot (Telemetry.flush)
     "counters": dict,
     "gauges": dict,
@@ -490,4 +503,10 @@ GAUGES: Dict[str, str] = {
                                      "own physical block",
     "serve_tenants_active": "distinct tenants with queued or active "
                             "requests at the last scheduler tick",
+    "autotune_candidate_failures": "autotuner candidates that refused "
+                                   "their shapes during timing, "
+                                   "cumulative (mirrors the counter; "
+                                   "occasional failures are normal — "
+                                   "a climb means a rotten candidate "
+                                   "list)",
 }
