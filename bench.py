@@ -102,6 +102,12 @@ def _config_fingerprint(env=None) -> str:
         "fp8_matmul": env.get("BENCH_FP8_MATMUL", ""),
         "tune_e2e": env.get("BENCH_TUNE_E2E", ""),
         "tune_plan": env.get("BENCH_TUNE_PLAN", ""),
+        # in-scan collective scheduler arms: the legacy-vs-composed A/B
+        # and the hpZ row carry their COMPOSITION in the fingerprint so
+        # the arms can never cross-replay (the composition string also
+        # lands in extra.sched.describe from the live engine)
+        "sched_compose": env.get("BENCH_SCHED_COMPOSE", ""),
+        "hpz": env.get("BENCH_HPZ", ""),
     }, sort_keys=True)
 
 
@@ -443,6 +449,37 @@ def _effective_xent_impl(cfg, n_chips: int, tokens=None) -> str:
                                tokens=tokens)
 
 
+def _sched_extra(engine, compiled_step, hpz_gran=None):
+    """extra.sched for the scheduler-composed / hpZ bench arms: the live
+    composition string, the merged program's per-slot overlap fractions,
+    and (under hpZ) the measured per-link wire split with the in-scan
+    gather slice — the before/after ledger rows the ROADMAP hpZ item
+    asks for come from running the legacy arm (its own fingerprint) next
+    to this one."""
+    from tiny_deepspeed_tpu.utils.hlo_comm import (
+        collective_ledger, gather_link_split_in_loops, overlap_report,
+        wire_link_split,
+    )
+    txt = compiled_step.as_text()
+    led = collective_ledger(txt)
+    rep = overlap_report(txt, led=led)
+    out = {
+        "describe": engine._schedule.describe(),
+        "lowering": engine._lowering,
+        "sched_gather_overlap_frac": round(
+            rep["gather_overlap_frac"], 4),
+        "sched_grad_overlap_frac": round(
+            rep["grad_comm_overlap_frac"], 4),
+        "gather_wire_bytes_in_loops": rep["gather_wire_bytes_in_loops"],
+        "reduce_wire_bytes_in_loops": rep["reduce_wire_bytes_in_loops"],
+    }
+    if hpz_gran is not None:
+        out["wire_bytes_by_link"] = wire_link_split(led, hpz_gran)
+        out["in_scan_gather_link"] = gather_link_split_in_loops(
+            led, hpz_gran)
+    return {"sched": out}
+
+
 def _gather_prefetch_extra(engine, compiled_step, gather_prefetch,
                            gather_quant):
     """Round-8 A/B labeling: the gather-prefetch config that actually ran
@@ -544,7 +581,7 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     gather_prefetch = os.environ.get("BENCH_GATHER_PREFETCH")
     if gather_prefetch:
         # round-8 A/B knob: ZeRO-3 layer-ahead weight-gather prefetch
-        # (engine gather_prefetch=, parallel/comm.GatherPrefetchScan).
+        # (engine gather_prefetch=, parallel/schedule.GatherPrefetchScan).
         # Setting the env var selects the Zero3 engine (the stage whose
         # per-layer gathers the knob schedules); K=1 is the byte-
         # identical on-demand baseline so the A/B pair shares a stage.
@@ -552,7 +589,44 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         if os.environ.get("BENCH_GATHER_GROUPS"):
             # hierarchical 2-hop gather: inner group size
             ek["gather_groups"] = int(os.environ["BENCH_GATHER_GROUPS"])
-    if gather_prefetch:
+    sched_compose = os.environ.get("BENCH_SCHED_COMPOSE")
+    bench_hpz = os.environ.get("BENCH_HPZ")
+    hpz_gran = None
+    if sched_compose:
+        # round-9 A/B: the scheduler-composed FULL STACK (ZeRO-3 +
+        # gather prefetch + bucketed quantized grads + per-layer
+        # health) vs the legacy single-feature arms — the legacy arm is
+        # a separate invocation (e.g. BENCH_GATHER_PREFETCH alone); the
+        # fingerprint keeps the rows apart
+        ek["gather_prefetch"] = int(
+            os.environ.get("BENCH_GATHER_PREFETCH") or 2)
+        ek["grad_buckets"] = int(
+            os.environ.get("BENCH_GRAD_BUCKETS") or 2)
+        ek["grad_comm"] = os.environ.get("BENCH_GRAD_COMM") or "int8"
+        from tiny_deepspeed_tpu.telemetry import Telemetry
+        ek["telemetry"] = Telemetry(layers=True)
+    if bench_hpz:
+        # hpZ secondary weight partitioning: real multi-slice granule
+        # map when the pod has one, else the emulated 2-slice split (the
+        # same emulation the wire_link_split tests pin).  A BENCH_HPZ
+        # row that cannot actually run hpz is REFUSED, not silently
+        # measured plain — the env var is in _config_fingerprint, so a
+        # mislabeled row would poison the before/after ledger A/B and
+        # collide with a later real hpz measurement
+        from tiny_deepspeed_tpu.parallel.mesh import granule_map
+        hpz_gran = granule_map(mesh.devices.flatten())
+        if hpz_gran is None and n_chips > 1 and n_chips % 2 == 0:
+            hpz_gran = {i: i // (n_chips // 2) for i in range(n_chips)}
+        if hpz_gran is None:
+            raise SystemExit(
+                "bench: BENCH_HPZ=1 needs a real multi-slice mesh or an "
+                f"even chip count >= 2 to emulate one (got {n_chips} "
+                "chips, single granule); refusing to record a plain row "
+                "under the hpz fingerprint"
+            )
+        ek["hpz"] = True
+        ek["hpz_granule_of"] = hpz_gran
+    if gather_prefetch or sched_compose or bench_hpz:
         from tiny_deepspeed_tpu import Zero3
         engine = Zero3(model, opt, mesh=mesh, **ek)
         b *= n_chips
@@ -720,6 +794,8 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             **(_gather_prefetch_extra(engine, compiled_step,
                                       gather_prefetch, gather_quant)
                if gather_prefetch else {}),
+            **(_sched_extra(engine, compiled_step, hpz_gran)
+               if (sched_compose or bench_hpz) else {}),
             "effective": {
                 "remat": str(cfg.remat),
                 "fused_xent": str(cfg.fused_xent),

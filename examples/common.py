@@ -190,7 +190,7 @@ def parse_args(default_model="gpt2-124m", **defaults):
     p.add_argument(
         "--gather-prefetch", type=int, default=0, metavar="K",
         help="ZeRO-3 layer-ahead weight-gather prefetch "
-             "(parallel/comm.GatherPrefetchScan): the block scan issues "
+             "(parallel/schedule.GatherPrefetchScan): the block scan issues "
              "layer k+(K-1)'s parameter all-gather while layer k "
              "computes, holding at most K layers' gathered weights (2 = "
              "double buffer), on the forward AND the remat backward; "
@@ -203,6 +203,19 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "resting precision (f8 under --gather-quant) within M-rank "
              "groups, compute dtype across groups (mirrors "
              "--grad-comm-groups; M must divide the data-axis size)",
+    )
+    p.add_argument(
+        "--sched", default=None, metavar="SPEC",
+        help="in-scan collective scheduler composition "
+             "(parallel/schedule.py), e.g. "
+             "'gather_prefetch=2,grad_buckets=4,grad_comm=int8,health,"
+             "hpz': each element declares one scheduler slot; 'health' "
+             "upgrades --telemetry to layers, 'hpz' holds a secondary "
+             "compute-dtype weight replica per slice so ZeRO-3's "
+             "in-scan gathers never cross DCN (ZeRO++).  Legacy flags "
+             "(--grad-comm/--grad-buckets/--gather-prefetch/...) keep "
+             "working and merge with this spec; --sched wins on "
+             "conflict",
     )
     p.add_argument(
         "--fused-xent", choices=("chunked", "pallas"), default=None,
@@ -405,12 +418,25 @@ def run(engine_cls, args, single_device=False):
             if p
         ),
     )
+    # --sched: ONE translation site — the composition spec parses into
+    # scheduler-slot engine kwargs (parallel/schedule.parse_sched_spec)
+    # and merges over the legacy per-knob flags ('health' upgrades the
+    # telemetry to layers mode)
+    sched_kw = {}
+    if getattr(args, "sched", None):
+        from tiny_deepspeed_tpu.parallel.schedule import parse_sched_spec
+        sched_kw = parse_sched_spec(args.sched)
     telem = None
-    if getattr(args, "telemetry", None):
+    # pop BEFORE the or: a short-circuit would leak the key into the
+    # engine kwargs when --telemetry layers is also set
+    sched_layers = sched_kw.pop("telemetry_layers", False)
+    want_layers = (getattr(args, "telemetry", None) == "layers"
+                   or sched_layers)
+    if getattr(args, "telemetry", None) or want_layers:
         from tiny_deepspeed_tpu.telemetry import Telemetry
         telem = Telemetry(
             trace_dir=getattr(args, "telemetry_trace", None),
-            layers=getattr(args, "telemetry", None) == "layers",
+            layers=want_layers,
             flight_steps=getattr(args, "flight_steps", 64),
         )
     train_kw = dict(
@@ -425,6 +451,7 @@ def run(engine_cls, args, single_device=False):
         gather_prefetch=getattr(args, "gather_prefetch", 0),
         gather_groups=getattr(args, "gather_groups", None),
     )
+    train_kw.update(sched_kw)
     if single_device:
         engine = engine_cls(
             model, opt, mesh=make_mesh(devices=[jax.devices()[0]]),

@@ -6,7 +6,7 @@ example/zero3/train.py:16-46 - completed here; the reference's is broken,
 SURVEY 2.18).
 
 Stage-3-specific flags: --gather-prefetch K (layer-ahead weight-gather
-prefetch, K=2 = double buffer; parallel/comm.GatherPrefetchScan),
+prefetch, K=2 = double buffer; parallel/schedule.GatherPrefetchScan),
 --gather-groups M (hierarchical 2-hop gather), --gather-quant fp8
 (ZeRO++-style f8 gathers) — they compose."""
 

@@ -141,7 +141,7 @@ timeout 2400 env BENCH_GRAD_BUCKETS=4 python bench.py > "$OUT/bench_gradbuckets_
 log "   fp32 K=4 rc=$? $(cat "$OUT/bench_gradbuckets_fp32_k4.json" 2>/dev/null | head -c 160)"
 
 log "17. ZeRO-3 gather-prefetch A/B (round-8: gather_prefetch= layer-ahead"
-log "    weight-gather prefetch, parallel/comm.GatherPrefetchScan — zero3"
+log "    weight-gather prefetch, parallel/schedule.GatherPrefetchScan — zero3"
 log "    1.5B, fp32 vs fp8 gathers x prefetch off(K=1)/on(K=2); the K=1"
 log "    runs are the byte-identical on-demand baselines on the SAME"
 log "    Zero3 engine.  Only meaningful multi-chip (1 chip = no gathers);"
@@ -176,5 +176,20 @@ timeout 2400 env BENCH_MODEL=gpt2-1.5b python bench.py > "$OUT/bench_1.5b_refres
 log "   1.5b rc=$? $(cat "$OUT/bench_1.5b_refresh.json" 2>/dev/null | head -c 160)"
 timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_FP8_MATMUL=on python bench.py > "$OUT/bench_1.5b_fp8.json" 2> "$OUT/bench_1.5b_fp8.err"
 log "   1.5b fp8 rc=$? $(cat "$OUT/bench_1.5b_fp8.json" 2>/dev/null | head -c 160)"
+
+log "19. composed scheduler A/B + hpZ (round-15: parallel/schedule.py —"
+log "    legacy single-feature arms (steps 16/17 rows above) vs the"
+log "    scheduler-composed FULL STACK in one program: ZeRO-3 +"
+log "    gather_prefetch=2 + grad_buckets=2 + int8 grad comm + per-layer"
+log "    health; extra.sched carries the merged program's per-slot"
+log "    overlap fractions.  The hpZ row records wire_bytes_by_link +"
+log "    the in-scan gather link split — before = the plain prefetch row"
+log "    from step 17, after = this row (in-scan gather DCN ~0)"
+timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_SCHED_COMPOSE=1 python bench.py > "$OUT/bench_sched_compose.json" 2> "$OUT/bench_sched_compose.err"
+log "   sched compose rc=$? $(cat "$OUT/bench_sched_compose.json" 2>/dev/null | head -c 200)"
+timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_HPZ=1 BENCH_GATHER_PREFETCH=2 python bench.py > "$OUT/bench_hpz.json" 2> "$OUT/bench_hpz.err"
+log "   hpz rc=$? $(cat "$OUT/bench_hpz.json" 2>/dev/null | head -c 200)"
+timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_HPZ=1 BENCH_SCHED_COMPOSE=1 python bench.py > "$OUT/bench_hpz_compose.json" 2> "$OUT/bench_hpz_compose.err"
+log "   hpz+compose rc=$? $(cat "$OUT/bench_hpz_compose.json" 2>/dev/null | head -c 200)"
 
 log "batch complete; results in $OUT"

@@ -205,7 +205,7 @@ class TestTpuTopologyHLO:
     def test_zero3_gather_prefetch_compiles_and_stays_in_loop(
             self, topo_mesh):
         """Round 8: the layer-ahead prefetched gather scan
-        (gather_prefetch=2, parallel/comm.GatherPrefetchScan) AOT-
+        (gather_prefetch=2, parallel/schedule.GatherPrefetchScan) AOT-
         compiles against the real TPU topology, keeps the per-layer
         all-gathers loop-resident (a hoisted gather would regrow
         full-model HBM — the scan_unroll footgun, now checkable), keeps

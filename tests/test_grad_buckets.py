@@ -2,7 +2,7 @@
 # SPDX-License-Identifier: Apache-2.0
 
 """Bucketed backward-overlapped gradient collectives (ZeroEngine
-grad_buckets=, parallel/comm.GradBucketTap, utils/hlo_comm.overlap_report).
+grad_buckets=, parallel/schedule.GradBucketTap, utils/hlo_comm.overlap_report).
 
 Pins the contract end to end: grad_buckets=1 HLO byte-identity with the
 monolithic path (the knob is free when off), 20-step loss parity with the
@@ -264,14 +264,19 @@ class TestEngineGradBuckets:
             DDP(model, AdamW(lr=1e-3), grad_buckets=3)  # n_layer=2
         with pytest.raises(ValueError, match="grad_buckets must be"):
             DDP(model, AdamW(lr=1e-3), grad_buckets=-1)
-        with pytest.raises(ValueError, match="stages 0-2"):
-            Zero3(model, AdamW(lr=1e-3), grad_buckets=2)
+        # the old "stages 0-2" refusal is LIFTED: ZeRO-3 + bucketed
+        # grads now lowers to the composed scheduler (implicit
+        # on-demand gather slot); likewise buckets x gather_quant —
+        # the composed machine accumulates dW in f32, so no e4m3
+        # cotangent ever reaches a bucket collective
+        assert Zero3(model, AdamW(lr=1e-3),
+                     grad_buckets=2)._lowering == "composed"
         with pytest.raises(ValueError, match="pure data-parallel"):
             DDP(model, AdamW(lr=1e-3), grad_buckets=2, tensor_parallel=2)
         import dataclasses
         q = GPT2Model(dataclasses.replace(TINY, gather_quant="fp8"))
-        with pytest.raises(ValueError, match="gather_quant"):
-            DDP(q, AdamW(lr=1e-3), grad_buckets=2)
+        assert DDP(q, AdamW(lr=1e-3),
+                   grad_buckets=2)._lowering == "composed"
         from tiny_deepspeed_tpu.models.moe import MoEConfig, MoEGPT
         moe = MoEGPT(MoEConfig(
             block_size=32, vocab_size=128, n_layer=2, n_head=2, n_embd=32,

@@ -390,8 +390,11 @@ class TestEngineGradComm:
     def test_unsupported_configs_raise(self, model):
         with pytest.raises(ValueError, match="grad_comm must be"):
             DDP(model, AdamW(lr=1e-3), grad_comm="int4")
-        with pytest.raises(ValueError, match="stages 0-2"):
-            Zero3(model, AdamW(lr=1e-3), grad_comm="int8")
+        # the old "stages 0-2" refusal is LIFTED: ZeRO-3 + quantized
+        # grads now lowers to the composed scheduler (the implicit
+        # on-demand gather slot supplies the in-region weight gathers)
+        eng = Zero3(model, AdamW(lr=1e-3), grad_comm="int8")
+        assert eng._lowering == "composed"
         with pytest.raises(ValueError, match="pure data-parallel"):
             DDP(model, AdamW(lr=1e-3), grad_comm="int8",
                 tensor_parallel=2)

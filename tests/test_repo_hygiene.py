@@ -410,3 +410,75 @@ def test_prefix_tenancy_schema_v9_names():
         "tenant": "abuser",
     })
     assert not errs, errs
+
+
+def test_no_scan_tap_custom_vjp_outside_schedule():
+    """Scheduler-consolidation guard (the PR-15 tentpole): the four-way
+    custom_vjp scan-tap drift this repo once carried (bucket taps,
+    prefetch scan, health probe, quantized schedule — each with its own
+    pairwise refusals) was unified into parallel/schedule.py.  No NEW
+    `jax.custom_vjp` scan-tap may appear under parallel/ or models/
+    outside schedule.py — per-layer in-scan work must be declared as a
+    scheduler SLOT instead, so the drift cannot regrow."""
+    import ast
+
+    # ring_attention's custom_vjp is an ATTENTION-KERNEL vjp (per-chunk
+    # softmax merge), not a scan tap riding the block scan — it predates
+    # the scheduler and schedules nothing
+    allow = {"parallel/ring_attention.py"}
+    offenders = {}
+    for sub in ("parallel", "models"):
+        root = os.path.join(REPO, "tiny_deepspeed_tpu", sub)
+        for fn in sorted(os.listdir(root)):
+            if not fn.endswith(".py") or fn == "schedule.py":
+                continue
+            rel = f"{sub}/{fn}"
+            if rel in allow:
+                continue
+            with open(os.path.join(root, fn)) as f:
+                tree = ast.parse(f.read())
+            hits = [
+                node.lineno for node in ast.walk(tree)
+                if isinstance(node, ast.Attribute)
+                and node.attr == "custom_vjp"
+            ]
+            if hits:
+                offenders[rel] = hits
+    assert not offenders, (
+        f"jax.custom_vjp scan-tap outside parallel/schedule.py: "
+        f"{offenders} — declare the per-layer work as a scheduler slot "
+        "(GatherSlot/GradSlot/ProbeSlot) in parallel/schedule.py instead "
+        "of growing a fifth bespoke tap"
+    )
+
+
+def test_scheduler_schema_v11_names():
+    """Schema-v11 drift guard (the in-scan collective scheduler): the
+    per-slot overlap gauges + the hpZ acceptance gauge must stay
+    documented AND registered by telemetry/registry.capture_compiled,
+    and the ledger must keep the loop-resident per-op group split the
+    hpZ pin reads."""
+    from tiny_deepspeed_tpu.telemetry import schema
+
+    assert schema.SCHEMA_VERSION >= 11
+    v11_gauges = {"sched_gather_overlap_frac", "sched_grad_overlap_frac",
+                  "hpz_dcn_wire_bytes"}
+    assert v11_gauges <= set(schema.GAUGES), (
+        v11_gauges - set(schema.GAUGES))
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "telemetry", "registry.py")) as f:
+        reg_src = f.read()
+    for g in sorted(v11_gauges):
+        assert f'"{g}"' in reg_src, (
+            f"gauge {g} documented in schema but no longer registered "
+            "by telemetry/registry.py capture_compiled"
+        )
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "utils", "hlo_comm.py")) as f:
+        hlo_src = f.read()
+    for name in ("wire_bytes_by_op_groups_in_loops",
+                 "gather_link_split_in_loops"):
+        assert name in hlo_src, (
+            f"{name} gone from utils/hlo_comm.py — the hpZ in-scan DCN "
+            "pin reads it"
+        )

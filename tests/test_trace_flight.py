@@ -180,10 +180,20 @@ class TestLayerHealth:
         with pytest.raises(ValueError, match="layer_health_capable"):
             DDP(moe, AdamW(lr=1e-3), telemetry=Telemetry(layers=True))
 
-    def test_rejected_with_grad_buckets(self, model):
-        with pytest.raises(ValueError, match="plain layer scan"):
-            DDP(model, AdamW(lr=1e-3), grad_buckets=2,
-                telemetry=Telemetry(layers=True))
+    def test_layers_composes_with_grad_buckets(self, model):
+        """Layer health x bucketed grads used to refuse; the scheduler
+        composes them now (probe + grad slots -> the composed lowering)
+        and the per-layer matrix still rides the step.  The deep parity
+        pins live in tests/test_schedule.py."""
+        telem = Telemetry(layers=True)
+        eng = DDP(model, AdamW(lr=1e-3), grad_buckets=2, telemetry=telem)
+        assert eng._lowering == "composed"
+        state = eng.init(jax.random.PRNGKey(0))
+        state, loss = eng.step(state, make_batch(3))
+        assert np.isfinite(float(loss))
+        mat = telem.layer_health()
+        assert mat is not None and mat.shape[0] == TINY.n_layer
+        assert np.all(np.isfinite(mat))
 
     def test_first_nonfinite_layer_resolution_order(self):
         mat = np.zeros((4, 6))

@@ -2,7 +2,7 @@
 # SPDX-License-Identifier: Apache-2.0
 
 """ZeRO-3 layer-ahead weight-gather prefetch (ZeroEngine gather_prefetch=,
-parallel/comm.GatherPrefetchScan, utils/hlo_comm.overlap_report gather side).
+parallel/schedule.GatherPrefetchScan, utils/hlo_comm.overlap_report gather side).
 
 Pins the contract end to end: gather_prefetch off (and K=1) HLO
 byte-identical to the on-demand zero3 program on the fp32 AND fp8-gather
@@ -351,16 +351,18 @@ class TestGatherWireModel:
 # ---------------------------------------------------------------------------
 
 class TestSatellites:
-    def test_grad_buckets_gather_quant_refusal_names_alternative(self):
-        """The engine's grad_buckets x gather_quant refusal (untested
-        until round 8) — and the message points at gather_prefetch as the
-        composable alternative."""
+    def test_grad_buckets_gather_quant_refusal_lifted(self):
+        """grad_buckets x gather_quant used to refuse (e4m3 cotangents
+        would have reached the tap collectives); the scheduler composes
+        them now — the composed backward accumulates dW in f32 before
+        each bucket release, so the combination lowers instead of
+        raising, and it trains."""
         q = GPT2Model(TINY_Q)
-        with pytest.raises(ValueError, match="does not compose with "
-                                             "gather_quant"):
-            DDP(q, AdamW(lr=1e-3), grad_buckets=2)
-        with pytest.raises(ValueError, match="gather_prefetch"):
-            DDP(q, AdamW(lr=1e-3), grad_buckets=2)
+        eng = DDP(q, AdamW(lr=1e-3), grad_buckets=2)
+        assert eng._lowering == "composed"
+        state = eng.init(jax.random.PRNGKey(0))
+        state, loss = eng.step(state, make_batch())
+        assert np.isfinite(float(loss))
 
     def test_offload_prefetch_validated_not_clamped(self, model):
         """offload_prefetch used to silently clamp via max(2, ...): now

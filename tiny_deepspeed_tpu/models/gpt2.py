@@ -246,20 +246,17 @@ class GPT2Model:
     # apply() implements the GPipe pipeline path (pctx.pipe_parallel);
     # subclasses that override apply() without it must reset this flag
     pipeline_capable = True
-    # apply() threads the engine's bucketed grad-release tap
-    # (parallel/comm.GradBucketTap) through the layer scan; subclasses
-    # that override apply() without the grad_tap branch must reset this
-    # (MoEGPT does — its scan carries the aux-loss accumulator)
+    # apply() threads the scheduler seam (parallel/schedule.py sched=)
+    # through the layer scan — the grad slot's bucketed release tap,
+    # and the composed lowering drives this family's block_fn/embed/head
+    # directly; subclasses that override apply() without the sched
+    # branch must reset these (MoEGPT does — its scan carries the
+    # aux-loss accumulator the scheduler's scan bodies do not thread)
     grad_bucket_capable = True
-    # apply() runs the ZeRO-3 layer-ahead prefetched weight-gather scan
-    # (parallel/comm.GatherPrefetchScan) when pctx.gather_prefetch >= 2;
-    # subclasses that override apply() without that branch must reset
-    # this (MoEGPT does — same aux-accumulator scan reason)
+    # the gather slot (ZeRO-3 prefetched / hpZ weight-gather scan)
     gather_prefetch_capable = True
-    # apply() threads the per-layer health probe
-    # (parallel/comm.layer_health_tap, engine telemetry layers mode)
-    # through the stacked scan tree; subclasses overriding apply()
-    # without the health_probe branch must reset this (MoEGPT does)
+    # the probe slot (per-layer health: schedule.layer_health_tap rides
+    # the stacked scan tree when a "health_probe" row is present)
     layer_health_capable = True
     # paged_prefill/paged_decode read and write the serving tier's paged
     # KV pool (serving/pool.py block tables); families whose decode step
@@ -922,7 +919,7 @@ class GPT2Model:
         def block(x, bp):
             y = self._block(x, bp, pctx)
             if "health_probe" in bp:
-                from ..parallel.comm import layer_health_tap
+                from ..parallel.schedule import layer_health_tap
                 y = layer_health_tap(y, bp["health_probe"])
             return y
 
@@ -982,8 +979,7 @@ class GPT2Model:
         return logits.astype(jnp.float32)
 
     def apply(self, params, idx, targets: Optional[jax.Array] = None,
-              pctx=None, position=None, rng=None, grad_tap=None,
-              health_probe=None):
+              pctx=None, position=None, rng=None, sched=None):
         """Forward pass.  Returns mean loss if targets given, else logits —
         same contract as reference GPT2Model.forward (model.py:139-157).
 
@@ -995,61 +991,27 @@ class GPT2Model:
         one key per layer rides the stacked scan tree, so the same masks
         are recomputed bit-exactly by the remat backward.
 
-        `grad_tap` (parallel/comm.GradBucketTap, engine grad_buckets > 1)
-        replaces the plain layer scan with the bucketed one: layers run
-        in K groups and each group's stacked-param slice passes through
-        the tap's identity custom_vjp, so the backward scan body emits
-        that bucket's gradient collective as soon as its grads are final.
-        None (default) keeps the exact single-scan program.
-
-        `health_probe` (engine telemetry layers mode) is a zeros
-        (n_layer, 4) f32 array the caller differentiates against: each
-        row rides the stacked scan tree like the per-layer dropout keys
-        and the block output passes through
-        parallel/comm.layer_health_tap, whose cotangent returns per-layer
-        activation/activation-gradient health stats.  None (default)
-        keeps the exact untapped program."""
+        `sched` is THE scheduler seam (parallel/schedule.py): an executor
+        with `.scan(block, stacked, x, unroll=)` that replaces the plain
+        layer scan — the probe row rider (ProbeScan), the bucketed
+        grad-release tap (GradBucketTap), or the prefetched weight-gather
+        scan (GatherPrefetchScan).  The engine builds it from the
+        validated slot Schedule; None (default) keeps the exact
+        single-scan program.  (The composed multi-slot lowering drives
+        its own scan via schedule.composed_step and never passes
+        sched= here.)"""
         x = self.embed(params, idx, pctx)
         stacked = self.stacked_compute_params(params)
         stacked, x = self._dropout_setup(stacked, x, rng)
-        if health_probe is not None:
-            if (pctx is not None and pctx.pipe_parallel) or \
-                    grad_tap is not None or (
-                        pctx is not None
-                        and getattr(pctx, "gather_prefetch", 0) > 1):
-                raise ValueError(
-                    "health_probe rides the plain layer scan; it does not "
-                    "compose with the pipeline forward, grad_tap, or the "
-                    "prefetched weight-gather scan"
-                )
-            stacked = dict(stacked, health_probe=health_probe)
         block = self.block_fn(pctx)
 
-        if grad_tap is not None:
+        if sched is not None:
             if pctx is not None and pctx.pipe_parallel:
                 raise ValueError(
-                    "grad_tap does not compose with the pipeline forward"
+                    "sched= (the in-scan collective scheduler) does not "
+                    "compose with the pipeline forward"
                 )
-            x = grad_tap.scan(block, stacked, x,
-                              unroll=self.config.scan_unroll)
-            return self.head(params, x, targets, pctx, position)
-
-        if (pctx is not None
-                and getattr(pctx, "gather_prefetch", 0) > 1
-                and pctx.is_multi_device and not pctx.pipe_parallel):
-            # ZeRO-3 layer-ahead weight-gather prefetch: explicit double-
-            # buffered gathers replace the GSPMD gather-on-demand scan,
-            # on the forward and (via the scan's custom_vjp) the remat
-            # backward.  The engine only sets pctx.gather_prefetch when
-            # the stage/mesh/model contract holds.
-            from ..parallel.comm import GatherPrefetchScan
-            pscan = GatherPrefetchScan(
-                pctx.gather_prefetch, pctx.mesh, pctx.stacked_specs,
-                pctx.stacked_shard_specs,
-                groups=pctx.gather_groups, data_axis=pctx.data_axis,
-                compute_dtype=self.config.compute_dtype,
-            )
-            x = pscan.scan(block, stacked, x,
+            x = sched.scan(block, stacked, x,
                            unroll=self.config.scan_unroll)
             return self.head(params, x, targets, pctx, position)
 

@@ -102,14 +102,13 @@ class MoEGPT(GPT2Model):
     # the GPipe pipeline (spmd_pipeline with_aux: bubble ticks masked)
     pipeline_capable = True
     # apply() below re-implements the layer scan with the aux-loss
-    # accumulator in the carry and does not thread the engine's bucketed
-    # grad-release tap; the engine rejects grad_buckets > 1 for it
+    # accumulator in the carry and does not thread the scheduler seam
+    # (parallel/schedule.py sched=): the grad slot's bucketed release,
+    # the gather slot's prefetched/hpZ scan, and the probe slot's
+    # health row all sit out — build_schedule refuses each, naming the
+    # slot (ScheduleConflictError for compositions)
     grad_bucket_capable = False
-    # ...nor the ZeRO-3 prefetched weight-gather scan (same aux-carry
-    # reason); the engine rejects gather_prefetch >= 2 for it
     gather_prefetch_capable = False
-    # ...nor the per-layer health probe (apply() takes no health_probe);
-    # the engine rejects telemetry layers mode for it
     layer_health_capable = False
     # ...nor the serving tier's paged decode: expert dispatch routes a
     # whole batch through static per-expert capacity, which a mixed-

@@ -14,6 +14,10 @@ from .partition import partition_tensors
 from .mesh import make_mesh, init_distributed
 from .engine import SingleDevice, DDP, Zero1, Zero2, Zero3, TrainState
 from .pipeline import spmd_pipeline
+from .schedule import (
+    GatherSlot, GradSlot, ProbeSlot, Schedule, ScheduleConflictError,
+    build_schedule,
+)
 
 __all__ = [
     "partition_tensors",
@@ -26,4 +30,10 @@ __all__ = [
     "Zero2",
     "Zero3",
     "TrainState",
+    "GatherSlot",
+    "GradSlot",
+    "ProbeSlot",
+    "Schedule",
+    "ScheduleConflictError",
+    "build_schedule",
 ]

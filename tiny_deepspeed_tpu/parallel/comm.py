@@ -1,12 +1,17 @@
 # Copyright 2026 tiny-deepspeed-tpu authors
 # SPDX-License-Identifier: Apache-2.0
 
-"""Explicit collective schedules: quantized gradient collectives
+"""Collective CODECS and wire geometry: quantized gradient collectives
 (blockwise int8/fp8 reduce-scatter with error feedback, hierarchical
-2-hop all-reduce), the bucketed backward-overlapped gradient release
-(GradBucketTap), and the ZeRO-3 layer-ahead weight-gather prefetch
-(GatherPrefetchScan — the forward/weight-side twin, with its own
-optional 2-hop gather).
+2-hop all-reduce), the bucket layout table, and the ring wire models.
+
+The scan-tap machinery that used to live here (the bucketed grad-release
+tap, the prefetched weight-gather scan, the per-layer health probe) is
+now owned by parallel/schedule.py — the ONE composable in-scan
+collective scheduler; this module keeps only the quantization primitives
+and schedules it calls.  The repo-hygiene guard
+(tests/test_repo_hygiene.py) pins that no jax.custom_vjp scan-tap grows
+back here.
 
 The gradient reduce-scatter/all-reduce is the dominant per-step wire cost
 in every ZeRO stage (utils/hlo_comm.py ring model, PROFILE.md), and until
@@ -356,444 +361,6 @@ def bucket_layout(shapes, n_layer: int, n_buckets: int, n_dev: int,
         ),
         "residual_len": n_buckets * bucket_pad + tail_pad,
     }
-
-
-def _make_tap(reduce_fn):
-    """Identity-forward custom_vjp whose BACKWARD runs `reduce_fn` on the
-    cotangent: `reduce_fn(grad_chunk_tree, extras) -> (reduced_chunk_tree,
-    extras_cotangent)`.  The reduced tree must match the chunk's leaf
-    dtypes exactly (custom_vjp checks the bwd output against the primal
-    avals); the extras cotangent is the smuggling channel — e.g. the new
-    error-feedback residual rides out of the backward as the "gradient"
-    of the residual slice that rode in."""
-    @jax.custom_vjp
-    def tap(chunk, extras):
-        return chunk
-
-    def fwd(chunk, extras):
-        return chunk, extras
-
-    def bwd(extras, g):
-        return reduce_fn(g, extras)
-
-    tap.defvjp(fwd, bwd)
-    return tap
-
-
-class GradBucketTap:
-    """Per-bucket gradient release inside the model's layer scan.
-
-    Built by the engine INSIDE its shard_map manual region over the data
-    axis and handed to `model.apply(..., grad_tap=self)`.  The model's
-    layer loop calls `scan(block, stacked, x, unroll=...)`: the stacked
-    (L, ...) leaves reshape to (K, L/K, ...), an outer lax.scan runs over
-    the K buckets with the layer scan inside, and each bucket's param
-    slice passes through an identity `custom_vjp` whose backward runs
-    this bucket's gradient collective.  That places the reduce for bucket
-    k INSIDE the backward scan body — issued while buckets k-1..0 still
-    have backward compute in flight for XLA's latency-hiding scheduler /
-    collective pipeliner to overlap — the reference's per-parameter
-    backward-hook all-reduce (reference ddp/module.py:36-78) and its
-    unshipped "communication bucketing" TODO (reference README.md:66-71),
-    expressed in XLA terms.
-
-    `extras` is a dict of per-bucket float32 side inputs, every leaf with
-    leading dim K, sliced by the outer scan and fed through the tap:
-
-      "res"  — (K, bucket_pad) error-feedback residual slices; the tap's
-               cotangent for it IS the new residual (smuggled out of the
-               backward through the vjp).
-      "acc"  — accumulated-gradient prefix chunks (grad accumulation:
-               the first A-1 microbatches sum locally, the final
-               microbatch's taps add the prefix before the one collective
-               per bucket).
-      "rng"  — stochastic-rounding key rows BITCAST to f32 (an integer
-               tap input would need a float0 cotangent; a 2-word bitcast
-               keeps the tap all-float).
-
-    Integer leaves of the stacked tree itself (the per-layer dropout
-    keys) stay OUTSIDE the tap for the same float0 reason."""
-
-    def __init__(self, n_buckets: int, reduce_fn, extras=None):
-        self.n_buckets = int(n_buckets)
-        self._tap = _make_tap(reduce_fn)
-        self.extras = extras or {}
-
-    def scan(self, block, stacked, x, unroll=1):
-        """Drop-in replacement for the model's plain layer scan: same
-        (x, stacked) -> x contract, buckets of layers instead of single
-        layers as the outer iteration."""
-        k = self.n_buckets
-
-        def resh(a):
-            return a.reshape((k, a.shape[0] // k) + a.shape[1:])
-
-        stacked_b = jax.tree.map(resh, stacked)
-
-        def bucket_body(carry, xs):
-            bp, ex = xs
-            tappable = {
-                n: v for n, v in bp.items()
-                if jnp.issubdtype(v.dtype, jnp.floating)
-            }
-            tapped = self._tap(tappable, ex)
-            bp = dict(bp, **tapped)
-
-            def layer(c, lp):
-                return block(c, lp), None
-
-            c, _ = jax.lax.scan(layer, carry, bp, unroll=unroll)
-            return c, None
-
-        x, _ = jax.lax.scan(bucket_body, x, (stacked_b, self.extras))
-        return x
-
-
-# ---------------------------------------------------------------------------
-# per-layer health probe (engine telemetry layers mode, ISSUE 5)
-# ---------------------------------------------------------------------------
-
-def _act_stats(x) -> jax.Array:
-    """(2,) f32: [sum of squares, non-finite element count] of one layer's
-    output activation.  Sums run over the LOGICAL array, so under sharded
-    activations XLA inserts the cross-shard psum and every rank reports
-    the same global numbers (the health_vector convention)."""
-    xf = x.astype(jnp.float32)
-    return jnp.stack([
-        jnp.sum(jnp.square(xf)),
-        jnp.sum((~jnp.isfinite(xf)).astype(jnp.float32)),
-    ])
-
-
-@jax.custom_vjp
-def layer_health_tap(x, probe):
-    """Identity on `x`; the (4,) f32 `probe`'s COTANGENT smuggles this
-    layer's health stats out of the step — [act sq-sum, act non-finite
-    count, d(act) sq-sum, d(act) non-finite count].
-
-    The GradBucketTap trick pointed at observability instead of
-    collectives: the engine differentiates the loss w.r.t. a zeros
-    (n_layer, 4) probe that rides the stacked scan tree (one (4,) row per
-    layer, like the per-layer dropout keys), each layer's block output
-    passes through this tap, and the "gradient" of the probe comes back
-    as the per-layer activation/activation-gradient stats — computed
-    INSIDE the compiled step, per layer, with no scan restructuring and
-    no extra host transfers.  The first-NaN layer is read off the stats
-    in one step instead of by bisection.  Forward stats are recomputed
-    bit-exactly by the remat backward (they live inside the block's
-    jax.checkpoint), so the fwd residual costs 2 floats per layer."""
-    return x
-
-
-def _lht_fwd(x, probe):
-    return x, _act_stats(x)
-
-
-def _lht_bwd(stats, g):
-    return g, jnp.concatenate([stats, _act_stats(g)])
-
-
-layer_health_tap.defvjp(_lht_fwd, _lht_bwd)
-
-# probe row width: [act_sq, act_nonfinite, dact_sq, dact_nonfinite]
-LAYER_PROBE_WIDTH = 4
-
-
-# ---------------------------------------------------------------------------
-# ZeRO-3 layer-ahead weight-gather prefetch (engine gather_prefetch=, ISSUE 4)
-# ---------------------------------------------------------------------------
-
-class GatherPrefetchScan:
-    """Layer-ahead weight-gather prefetch for the ZeRO-3 block scan.
-
-    Under plain ZeRO-3 the per-layer all-gather is GSPMD-implicit: the
-    scan slices layer k's sharded weights and the partitioner gathers
-    them AT THE TOP of body k — serialized in front of layer k's compute
-    (DeepSpeed ships stage-3 parameter prefetch for exactly this cost;
-    ZeRO++ qwZ quantizes the same gathers).  This scan makes the gather
-    explicit and moves it one-plus layers AHEAD: body k issues layer
-    k+(K-1)'s gather (a sharding constraint to the gathered layout — or
-    the 2-hop shard_map schedule under `groups`) while layer k computes
-    from the double buffer carried through the scan, so the latency-
-    hiding scheduler can overlap gather wire with block compute.  At most
-    K layers' gathered weights are live (K=2 = classic double buffer).
-
-    The SAME structure runs on the backward: the whole prefetched stack
-    is an identity-story `custom_vjp` (the GradBucketTap machinery, the
-    symmetric twin on the forward/weight side) whose bwd is a reverse
-    scan over layers — recompute layer k's block from the stashed input
-    activation (remat, policy "nothing": only the L per-layer activations
-    are saved, same as the plain remat stash) while prefetching layer
-    k-(K-1)'s weights for the NEXT backward body, and constraining each
-    layer's dW to the sharded layout so the grad reduce-scatter stays
-    in-loop too.  Integer leaves of the stacked tree (the per-layer
-    dropout keys) cross the custom_vjp boundary bitcast to f32 (the PR-3
-    tap rule: no float0 cotangents), and ride the scan un-prefetched —
-    they are replicated scalars, there is no wire to hide.
-
-    `groups=m` (engine `gather_groups`) runs the hierarchical 2-hop
-    gather, mirroring `grad_comm_groups`: hop 1 all-gathers each leaf's
-    shards WITHIN m consecutive ranks at the resting precision (f8 when
-    the leaf is `gather_quant`-quantized), dequantizes the group chunk
-    once, hop 2 all-gathers the compute-dtype chunks ACROSS groups —
-    "fp8 intra-group, bf16 inter-group" on a bf16-compute model.  Leaves
-    the ZeRO layout left replicated (norm weights on small models,
-    biases, scales) skip the shard_map: they have no shards to gather.
-
-    Cost model: each pass (fwd, and the bwd re-forward) issues K-1 extra
-    clamped end-of-scan gathers — (L+K-1)/L of the on-demand gather wire
-    (priced in utils/profiling.comm_report); `utils/hlo_comm.
-    overlap_report` measures the placement (`gather_overlap_frac`)."""
-
-    def __init__(self, depth: int, mesh, gather_specs, shard_specs, *,
-                 groups: Optional[int] = None, data_axis: str = "data",
-                 compute_dtype=jnp.bfloat16):
-        if depth < 2:
-            raise ValueError(
-                f"GatherPrefetchScan needs depth >= 2 (depth-1 layers of "
-                f"lookahead), got {depth}"
-            )
-        self.depth = int(depth)
-        self.mesh = mesh
-        self.gather_specs = dict(gather_specs or {})
-        self.shard_specs = dict(shard_specs or {})
-        self.groups = int(groups) if groups else None
-        self.data_axis = data_axis
-        self.cd = compute_dtype
-
-    # -- one layer's gather --------------------------------------------------
-
-    def _shard_dim(self, name: str) -> Optional[int]:
-        """Index of the ZeRO data-sharded dim in the SLICED leaf, or None
-        when the layout left it replicated (nothing to gather)."""
-        spec = self.shard_specs.get(name)
-        if spec is None:
-            return None
-        for i, ax in enumerate(spec):
-            if ax == self.data_axis or (
-                isinstance(ax, tuple) and self.data_axis in ax
-            ):
-                return i
-        return None
-
-    def _dequant_names(self, sliced) -> Tuple[str, ...]:
-        """Leaves the 2-hop gather dequantizes between hops: quantized
-        (a '#scale' partner exists) AND data-sharded (they go through the
-        shard_map; replicated leaves never enter it)."""
-        if not self.groups:
-            return ()
-        return tuple(sorted(
-            n for n in sliced
-            if n + "#scale" in sliced and self._shard_dim(n) is not None
-        ))
-
-    def _gather(self, sliced):
-        """One layer's float leaves, sharded slice -> gathered block-param
-        tree.  Flat path: a sharding constraint per leaf to its gathered
-        spec (f8 + scale kept; the block's `_bw` dequantizes after the
-        gather, exactly the on-demand fp8 contract).  2-hop path: explicit
-        shard_map all-gathers; quantized leaves come back DEQUANTIZED in
-        compute dtype with their scales dropped (hop 2 moved the
-        dequantized chunks)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        if not self.groups:
-            out = {}
-            for name, v in sliced.items():
-                spec = self.gather_specs.get(name)
-                if spec is not None:
-                    v = jax.lax.with_sharding_constraint(
-                        v, NamedSharding(self.mesh, spec))
-                out[name] = v
-            return out
-
-        n = self.mesh.shape[self.data_axis]
-        inner = self.groups
-        intra, inter = _hier_groups(n, inner)
-        cd = self.cd
-        dq = set(self._dequant_names(sliced))
-        sharded, dims, scales, out = {}, {}, {}, {}
-        for name, v in sliced.items():
-            if name.endswith("#scale") and name[: -len("#scale")] in dq:
-                continue  # consumed by its weight's inter-hop dequant
-            d = self._shard_dim(name)
-            if d is None:
-                out[name] = v  # replicated at rest: no shards to gather
-                continue
-            sharded[name] = v
-            dims[name] = d
-            if name in dq:
-                scales[name] = sliced[name + "#scale"]
-        if not sharded:
-            return out
-
-        def local(vals, scs):
-            res = {}
-            for name, v in vals.items():
-                dim = dims[name]
-                g1 = jax.lax.all_gather(
-                    v, self.data_axis, axis=dim, tiled=True,
-                    axis_index_groups=intra)
-                s = scs.get(name)
-                if s is not None:
-                    # dequantize ONCE per group chunk; hop 2 moves the
-                    # compute-dtype values (fp8 intra, bf16 inter)
-                    g1 = g1.astype(cd) * s.astype(cd)
-                res[name] = jax.lax.all_gather(
-                    g1, self.data_axis, axis=dim, tiled=True,
-                    axis_index_groups=inter)
-            return res
-
-        vspecs = {
-            name: P(*(self.data_axis if i == dims[name] else None
-                      for i in range(v.ndim)))
-            for name, v in sharded.items()
-        }
-        sspecs = {name: P() for name in scales}
-        ospecs = {name: P() for name in sharded}
-        gathered = jax.shard_map(
-            local, mesh=self.mesh, in_specs=(vspecs, sspecs),
-            out_specs=ospecs, check_vma=False,
-        )(sharded, scales)
-        out.update(gathered)
-        return out
-
-    def _pullback(self, dwg, sfk):
-        """Map the block-vjp cotangent (gathered structure) back onto the
-        sliced stacked-tree structure.  Flat path: identity.  2-hop path:
-        the dequant multiply lived inside the gather, so dequantized
-        leaves' compute-dtype cotangents pull back through it here
-        (d_f8 = dw * scale, cast; scale cotangent zero — it is
-        stop-gradiented upstream by stacked_compute_params)."""
-        dq = self._dequant_names(sfk)
-        if not dq:
-            return dict(dwg)
-        out = dict(dwg)
-        for name in dq:
-            s = sfk[name + "#scale"]
-            out[name] = (
-                dwg[name].astype(jnp.float32) * s.astype(jnp.float32)
-            ).astype(sfk[name].dtype)
-            out[name + "#scale"] = jnp.zeros_like(s)
-        return out
-
-    def _constrain_shard(self, name: str, g):
-        """Pin one layer's dW cotangent to the sharded slice layout so the
-        grad reduce-scatter is emitted INSIDE the backward scan body (the
-        on-demand path's property, kept)."""
-        from jax.sharding import NamedSharding
-
-        spec = self.shard_specs.get(name)
-        if spec is None:
-            return g
-        return jax.lax.with_sharding_constraint(
-            g, NamedSharding(self.mesh, spec))
-
-    # -- the scan ------------------------------------------------------------
-
-    def scan(self, block, stacked, x, unroll=1):
-        """Drop-in replacement for the model's plain layer scan: same
-        (x, stacked) -> x contract, with layer k+(K-1)'s gather issued in
-        body k on the forward AND the reverse (remat backward) scan."""
-        fkeys = sorted(
-            n for n, v in stacked.items()
-            if not jnp.issubdtype(v.dtype, jnp.integer)
-        )
-        ikeys = sorted(n for n in stacked if n not in set(fkeys))
-        idtypes = {n: stacked[n].dtype for n in ikeys}
-        L = int(jax.tree.leaves(stacked)[0].shape[0])
-        look = self.depth - 1
-        if look >= L:
-            raise ValueError(
-                f"gather_prefetch={self.depth} holds more layers than the "
-                f"model has (n_layer={L})"
-            )
-
-        def slice_f(sf, i):
-            return {
-                n: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
-                for n, a in sf.items()
-            }
-
-        def int_slices(si_b, i):
-            return {
-                n: jax.lax.bitcast_convert_type(
-                    jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
-                    idtypes[n])
-                for n, a in si_b.items()
-            }
-
-        def init_buf(sf, idxs):
-            slots = [self._gather(slice_f(sf, i)) for i in idxs]
-            return jax.tree.map(lambda *xs: jnp.stack(xs), *slots)
-
-        def shift_in(buf, new):
-            return jax.tree.map(
-                lambda b, nw: jnp.concatenate([b[1:], nw[None]]), buf, new)
-
-        def fwd_scan(sf, si_b, x0, stash):
-            buf = init_buf(sf, list(range(look)))
-
-            def body(carry, k):
-                x, buf = carry
-                # issue layer k+look's gather FIRST; nothing in this body
-                # consumes it, so its wire can hide behind block(k)
-                nxt = self._gather(
-                    slice_f(sf, jnp.minimum(k + look, L - 1)))
-                w = jax.tree.map(lambda b: b[0], buf)
-                y = block(x, dict(w, **int_slices(si_b, k)))
-                return (y, shift_in(buf, nxt)), (x if stash else None)
-
-            (y, _), xs = jax.lax.scan(
-                body, (x0, buf), jnp.arange(L), unroll=unroll)
-            return y, xs
-
-        @jax.custom_vjp
-        def run(sf, si_b, x0):
-            y, _ = fwd_scan(sf, si_b, x0, stash=False)
-            return y
-
-        def run_fwd(sf, si_b, x0):
-            y, xs = fwd_scan(sf, si_b, x0, stash=True)
-            # residuals: the SHARDED stacked tree (no copy) + the L
-            # per-layer input activations — the plain remat stash
-            return y, (sf, si_b, xs)
-
-        def run_bwd(res, dy):
-            sf, si_b, xs = res
-            buf = init_buf(sf, [L - 1 - i for i in range(look)])
-
-            def body(carry, inp):
-                dx, buf = carry
-                x_k, k = inp
-                nxt = self._gather(
-                    slice_f(sf, jnp.maximum(k - look, 0)))
-                w = jax.tree.map(lambda b: b[0], buf)
-                ints = int_slices(si_b, k)
-
-                def f(x_, wf):
-                    return block(x_, dict(wf, **ints))
-
-                # remat: recompute layer k's block from the stashed input
-                _, vjp = jax.vjp(f, x_k, w)
-                dx_new, dwg = vjp(dx)
-                dw = self._pullback(dwg, slice_f(sf, k))
-                dw = {n: self._constrain_shard(n, g)
-                      for n, g in dw.items()}
-                return (dx_new, shift_in(buf, nxt)), dw
-
-            (dx, _), dws = jax.lax.scan(
-                body, (dy, buf), (xs, jnp.arange(L)), reverse=True,
-                unroll=unroll)
-            return dws, jax.tree.map(jnp.zeros_like, si_b), dx
-
-        run.defvjp(run_fwd, run_bwd)
-        return run(
-            {n: stacked[n] for n in fkeys},
-            {n: jax.lax.bitcast_convert_type(stacked[n], jnp.float32)
-             for n in ikeys},
-            x,
-        )
 
 
 def modeled_gather_wire_bytes(block_rest_bytes: int, block_cd_bytes: int,

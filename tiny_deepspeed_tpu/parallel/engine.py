@@ -237,6 +237,8 @@ class ZeroEngine:
         grad_buckets: int = 1,
         gather_prefetch: int = 0,
         gather_groups: Optional[int] = None,
+        hpz: bool = False,
+        hpz_granule_of: Optional[Dict[int, int]] = None,
     ):
         """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
         tokens shard over it and attention runs as a ppermute ring
@@ -284,13 +286,15 @@ class ZeroEngine:
         to an un-knobbed engine (tests/test_telemetry.py pins the HLO).
         A Telemetry constructed with layers=True additionally turns on
         per-layer health: the block scan taps every layer's output
-        (parallel/comm.layer_health_tap) and the step also returns an
-        (n_layer, 6) matrix of per-layer activation/activation-gradient/
-        gradient norms and non-finite counts (telemetry/health.
-        LAYER_FIELDS) — the first-NaN layer is localized in one step.
-        Plain-scan engines only (no pipeline/1f1b/grad_buckets/quantized
-        grad_comm/gather_prefetch — rejected loudly) and the model must
-        be layer_health_capable (GPT-2/Llama; MoE is not).  With layers
+        (parallel/schedule.layer_health_tap — the scheduler's probe
+        slot) and the step also returns an (n_layer, 6) matrix of
+        per-layer activation/activation-gradient/gradient norms and
+        non-finite counts (telemetry/health.LAYER_FIELDS) — the
+        first-NaN layer is localized in one step.  Composes with
+        grad_buckets / quantized grad_comm / gather_prefetch / hpz via
+        the composed scheduler lowering (pipeline forwards still
+        refuse), model permitting (layer_health_capable: GPT-2/Llama;
+        MoE is not).  With layers
         off the program is byte-identical to plain telemetry
         (tests/test_trace_flight.py pins the HLO).
 
@@ -309,13 +313,18 @@ class ZeroEngine:
         many consecutive ranks per low-precision intra-group hop, bf16
         across groups — for 2D meshes/tori where the inner group maps to
         the fast links); `grad_comm_error_feedback=False` drops the
-        residual (saves its memory, costs convergence margin).  Supported
-        with stages 0-2 on a pure data-parallel mesh (no tp/sp/ep/pp —
-        the local-grad shard_map replays the model with pctx=None, the
-        same manual-region contract as the MoE pure-DP dispatch) and
-        composes with accumulation (microbatches accumulate locally, ONE
-        quantized sync per step — quantized accumulation would compound
-        error), grad clipping, loss scaling, and telemetry.  Under
+        residual (saves its memory, costs convergence margin).  Needs a
+        pure data-parallel mesh (no tp/sp/ep/pp — the explicit schedule
+        replays the model inside a shard_map over the data axis, the
+        same manual-region contract as the MoE pure-DP dispatch).
+        Stages 0-2 run the legacy monolithic/bucketed lowerings
+        unchanged; ZeRO-3 now composes too — the scheduler declares an
+        implicit on-demand gather slot and runs the merged program
+        (parallel/schedule.composed_step).  Composes with accumulation
+        on the legacy lowerings (microbatches accumulate locally, ONE
+        quantized sync per step; the composed lowering refuses accum
+        loudly), grad clipping, loss scaling, and telemetry INCLUDING
+        layers mode.  Under
         stage >= 2 the dequantized full gradient does materialize
         per-device before the sharding constraint re-slices it — the
         wire-vs-memory trade qgZ makes; keep fp32 when grad memory, not
@@ -323,7 +332,7 @@ class ZeroEngine:
         1-device data axis.
 
         grad_buckets: bucketed backward-overlapped gradient release
-        (parallel/comm.GradBucketTap).  With K > 1 the gradient is split
+        (parallel/schedule.GradBucketTap).  With K > 1 the gradient is split
         into K size-balanced buckets of consecutive layers (the stacked
         "h.*" leaves; K must divide n_layer) plus a tail bucket for the
         non-block leaves, and each layer bucket's collective — fp32
@@ -341,18 +350,19 @@ class ZeroEngine:
         telemetry gauge).  grad_buckets=1 (default) keeps the exact
         monolithic program (byte-identical, pinned by
         tests/test_grad_buckets.py).  Same mesh contract as quantized
-        grad_comm (pure data-parallel, stages 0-2, model replayed with
-        pctx=None inside a shard_map over the data axis) — plus the
-        model must be grad_bucket_capable (GPT-2/Llama; MoE's scan
-        carries an aux accumulator and is not) and gather_quant must be
-        off (f8 stacked leaves would put e4m3 cotangents on the wire
-        path).  Composes with grad_comm modes, accumulation (buckets
-        fire only on the final microbatch, the accumulated prefix rides
-        into the taps), grad clip, loss scaling, and telemetry.  Inert
+        grad_comm (pure data-parallel, model replayed with pctx=None
+        inside a shard_map over the data axis) — plus the model must be
+        grad_bucket_capable (GPT-2/Llama; MoE's scan carries an aux
+        accumulator and is not).  ZeRO-3 and gather_quant compose via
+        the scheduler's composed lowering (dW accumulates in f32 before
+        each release, so no e4m3 cotangent reaches the wire).  Composes
+        with grad_comm modes, accumulation (legacy lowering only, with
+        buckets firing on the final microbatch), grad clip, loss
+        scaling, and telemetry including layers.  Inert
         (warning) on a 1-device data axis.
 
         gather_prefetch: ZeRO-3 layer-ahead weight-gather prefetch
-        (parallel/comm.GatherPrefetchScan) — the forward/weight-side
+        (parallel/schedule.GatherPrefetchScan) — the forward/weight-side
         twin of grad_buckets.  With K >= 2 the block scan issues layer
         k+(K-1)'s parameter all-gather explicitly while layer k
         computes, holding at most K layers' gathered weights (K=2 =
@@ -379,6 +389,24 @@ class ZeroEngine:
         gathers per pass — (L+K-1)/L of the on-demand gather wire,
         priced in comm_report; placement measured by
         utils/hlo_comm.overlap_report (gather_overlap_frac).
+
+        hpz: ZeRO++-style secondary weight partitioning
+        (arXiv:2306.10209; parallel/schedule.py composed lowering).
+        Each rank holds, next to its global fp32 ZeRO-3 shard, its
+        SLICE's share of a full compute-dtype (bf16/fp8) block-weight
+        replica — rebuilt once per step by a single top-level
+        inter-slice all-gather — so every in-scan forward/backward
+        weight gather runs over the intra-slice group only and moves
+        ZERO DCN bytes (pinned via utils/hlo_comm.
+        gather_link_split_in_loops on the emulated 2-slice mesh; the
+        hpz_dcn_wire_bytes gauge).  The optimizer shards stay global
+        ZeRO-3; the replica is stashed as a backward residual (HBM
+        cost: compute-dtype block bytes / intra-slice ranks, per rank
+        — PROFILE.md).  Requires ZeRO-3 + a pure-DP mesh with >= 2
+        equal contiguous DCN granules (slices/processes;
+        `hpz_granule_of` overrides the parallel/mesh.granule_map
+        derivation for CPU-emulated tests).  Composes with
+        gather_prefetch, grad_buckets/grad_comm, and telemetry layers.
 
         offload_opt_state: ZeRO-Offload-style placement — optimizer
         moments REST in host memory (NamedSharding memory_kind
@@ -516,10 +544,14 @@ class ZeroEngine:
         # ZeRO sharding happens over the data axis only
         self.n_shard = mesh.shape["data"]
 
-        # quantized gradient collectives (parallel/comm.py) — settle the
-        # gate before shardings/_build_step: the error-feedback residual
-        # is part of the TrainState layout
-        from .comm import GRAD_COMM_MODES, padded_size
+        # ---- the in-scan collective scheduler (parallel/schedule.py) ----
+        # Every tap-style knob (grad_comm / grad_buckets / gather_prefetch
+        # / hpz / telemetry layers) becomes a SLOT declaration; ONE
+        # build_schedule call validates the composition and picks the
+        # lowering -- legacy single-slot programs stay byte-identical, any
+        # real composition runs the merged composed_step machine.
+        from . import schedule as _sched
+        from .comm import GRAD_COMM_MODES
         if grad_comm not in GRAD_COMM_MODES:
             raise ValueError(
                 f"grad_comm must be one of {GRAD_COMM_MODES}, "
@@ -538,101 +570,11 @@ class ZeroEngine:
                 "(grad_comm='fp32' runs no quantized schedule)"
             )
         self.grad_comm_error_feedback = bool(grad_comm_error_feedback)
-        self._grad_comm_active = (
-            grad_comm != "fp32" and self.data_parallel and self.n_shard > 1
-        )
-        if grad_comm != "fp32":
-            if self.stage >= 3:
-                # ZeRO-3 params rest sharded: the local-grad shard_map
-                # would need per-layer gathers INSIDE the manual region
-                raise ValueError(
-                    "grad_comm quantization supports stages 0-2 (ZeRO-3 "
-                    "params rest sharded; its per-layer gathers are "
-                    "already quantizable via gather_quant='fp8')"
-                )
-            busy = [ax for ax in (self.seq_axis, self.model_axis,
-                                  self.expert_axis, self.pipe_axis)
-                    if ax is not None]
-            if busy:
-                raise ValueError(
-                    f"grad_comm quantization needs a pure data-parallel "
-                    f"mesh (the local-grad shard_map replays the model "
-                    f"with pctx=None); active axes: {busy}"
-                )
-            if not self._grad_comm_active:
-                warnings.warn(
-                    f"grad_comm={grad_comm!r} is inert on a 1-device "
-                    "data axis (there is no gradient collective to "
-                    "quantize); running the exact fp32 path",
-                    stacklevel=2,
-                )
-        if self._grad_comm_active:
-            inner = self.grad_comm_groups
-            if inner is not None and (
-                inner < 2 or inner >= self.n_shard
-                or self.n_shard % inner
-            ):
-                raise ValueError(
-                    f"grad_comm_groups={inner} must be a proper divisor "
-                    f"of the data-axis size {self.n_shard} (>= 2)"
-                )
-
-        # bucketed backward-overlapped gradient release (grad_buckets=):
-        # same explicit-schedule mesh contract as quantized grad_comm,
-        # plus the model must thread the tap through its layer scan
         self.grad_buckets = int(grad_buckets) if grad_buckets else 1
         if self.grad_buckets < 1:
             raise ValueError(
                 f"grad_buckets must be >= 1, got {grad_buckets}"
             )
-        self._bucketed_active = (
-            self.grad_buckets > 1 and self.data_parallel
-            and self.n_shard > 1
-        )
-        if self.grad_buckets > 1:
-            if self.stage >= 3:
-                raise ValueError(
-                    "grad_buckets supports stages 0-2 (ZeRO-3 params "
-                    "rest sharded; the local-grad shard_map would need "
-                    "per-layer gathers inside the manual region)"
-                )
-            busy = [ax for ax in (self.seq_axis, self.model_axis,
-                                  self.expert_axis, self.pipe_axis)
-                    if ax is not None]
-            if busy:
-                raise ValueError(
-                    f"grad_buckets needs a pure data-parallel mesh (the "
-                    f"local-grad shard_map replays the model with "
-                    f"pctx=None); active axes: {busy}"
-                )
-            if not getattr(model, "grad_bucket_capable", False):
-                raise ValueError(
-                    f"{type(model).__name__} does not thread the bucketed "
-                    "grad-release tap through its layer scan "
-                    "(grad_bucket_capable=False)"
-                )
-            if getattr(getattr(model, "config", None), "gather_quant",
-                       None):
-                raise ValueError(
-                    "grad_buckets does not compose with gather_quant "
-                    "(the f8 stacked leaves' cotangents would reach the "
-                    "bucket collectives in e4m3); for overlapped "
-                    "quantized-weight traffic use ZeRO-3 with "
-                    "gather_prefetch instead — gather_quant='fp8' and "
-                    "gather_prefetch compose"
-                )
-            if not self._bucketed_active:
-                warnings.warn(
-                    f"grad_buckets={self.grad_buckets} is inert on a "
-                    "1-device data axis (there is no gradient collective "
-                    "to overlap); running the monolithic path",
-                    stacklevel=2,
-                )
-
-        # ZeRO-3 layer-ahead weight-gather prefetch (gather_prefetch=):
-        # the forward/weight-side twin of grad_buckets — settle the gate
-        # here; the pctx gains the knob + sharded slice specs below, once
-        # the layout tables exist
         self.gather_prefetch = int(gather_prefetch) if gather_prefetch \
             else 0
         if self.gather_prefetch < 0:
@@ -641,73 +583,54 @@ class ZeroEngine:
                 f"gather; K >= 2 holds K layers), got {gather_prefetch}"
             )
         self.gather_groups = int(gather_groups) if gather_groups else None
-        self._gather_prefetch_active = (
-            self.gather_prefetch > 1 and self.data_parallel
-            and self.n_shard > 1
+        if self.gather_groups and self.gather_prefetch <= 1:
+            # loud rejection, not a silently-flat gather mislabeled
+            # as the 2-hop schedule (the grad_comm_groups convention)
+            raise ValueError(
+                "gather_groups requires gather_prefetch >= 2 (the "
+                "2-hop gather lives in the explicit prefetched "
+                "schedule)"
+            )
+        self.hpz = bool(hpz)
+        granule_of = hpz_granule_of
+        if self.hpz and granule_of is None:
+            from .mesh import granule_map
+            granule_of = granule_map(mesh.devices.flatten())
+
+        # telemetry attrs settle BEFORE the schedule build (the probe
+        # slot comes from Telemetry(layers=True))
+        self.telemetry = telemetry
+        self._telemetry_on = telemetry is not None
+        if self._telemetry_on and hasattr(telemetry, "attach"):
+            telemetry.attach(self)
+        self._layers_on = bool(
+            self._telemetry_on and getattr(telemetry, "layers", False)
         )
-        if self.gather_prefetch > 1:
-            if self.stage != 3:
-                raise ValueError(
-                    "gather_prefetch requires ZeRO-3 (stages 0-2 keep "
-                    "params replicated/gathered once — there is no "
-                    "per-layer weight gather to prefetch)"
-                )
-            if not getattr(model, "gather_prefetch_capable", False):
-                raise ValueError(
-                    f"{type(model).__name__} does not thread the "
-                    "prefetched weight-gather scan through its layer "
-                    "loop (gather_prefetch_capable=False)"
-                )
-            if self.pipe_axis is not None:
-                raise ValueError(
-                    "gather_prefetch does not compose with "
-                    "pipeline_parallel (the pipe axis owns the stacked "
-                    "layer dim the prefetch scan slices)"
-                )
-            if _unroll is True or _unroll not in (1, False):
-                raise ValueError(
-                    "gather_prefetch rides the layer scan; it cannot "
-                    "combine with scan_unroll != 1"
-                )
-            _nl = getattr(getattr(model, "config", None), "n_layer", None)
-            if _nl is not None and self.gather_prefetch > _nl:
-                raise ValueError(
-                    f"gather_prefetch={self.gather_prefetch} holds more "
-                    f"layers than the model has (n_layer={_nl})"
-                )
-            if not self._gather_prefetch_active:
-                warnings.warn(
-                    f"gather_prefetch={self.gather_prefetch} is inert on "
-                    "a 1-device data axis (there is no weight gather to "
-                    "prefetch); running the on-demand path",
-                    stacklevel=2,
-                )
-        if self.gather_groups:
-            if self.gather_prefetch <= 1:
-                # loud rejection, not a silently-flat gather mislabeled
-                # as the 2-hop schedule (the grad_comm_groups convention)
-                raise ValueError(
-                    "gather_groups requires gather_prefetch >= 2 (the "
-                    "2-hop gather lives in the explicit prefetched "
-                    "schedule)"
-                )
-            busy = [ax for ax in (self.seq_axis, self.model_axis,
-                                  self.expert_axis, self.pipe_axis)
-                    if ax is not None]
-            if busy:
-                raise ValueError(
-                    f"gather_groups needs a pure data-parallel mesh (the "
-                    f"2-hop gather runs a shard_map over the data axis); "
-                    f"active axes: {busy}"
-                )
-            if self._gather_prefetch_active:
-                inner = self.gather_groups
-                if inner < 2 or inner >= self.n_shard \
-                        or self.n_shard % inner:
-                    raise ValueError(
-                        f"gather_groups={inner} must be a proper divisor "
-                        f"of the data-axis size {self.n_shard} (>= 2)"
-                    )
+        self._layer_count = int(
+            getattr(getattr(model, "config", None), "n_layer", 0) or 0
+        )
+
+        busy_axes = (self.seq_axis, self.model_axis, self.expert_axis,
+                     self.pipe_axis)
+        self._schedule = _sched.build_schedule(
+            model=model, stage=self.stage, n_shard=self.n_shard,
+            busy_axes=busy_axes, accum_steps=self.accum_steps,
+            scan_unroll=_unroll, grad_comm=grad_comm,
+            grad_comm_block=self.grad_comm_block,
+            grad_comm_groups=self.grad_comm_groups,
+            grad_comm_error_feedback=self.grad_comm_error_feedback,
+            grad_buckets=self.grad_buckets,
+            gather_prefetch=self.gather_prefetch,
+            gather_groups=self.gather_groups,
+            hpz=self.hpz, granule_of=granule_of,
+            telemetry_layers=self._layers_on,
+            pipeline=self.pipe_axis is not None or self._use_1f1b,
+        )
+        self._lowering = self._schedule.lowering
+        sg, sr = self._schedule.gather, self._schedule.grad
+        self._grad_comm_active = sr is not None and sr.mode != "fp32"
+        self._bucketed_active = sr is not None and sr.buckets > 1
+        self._gather_prefetch_active = sg is not None and sg.prefetch > 1
 
         shapes = model.param_shapes()
         # API-parity ownership table (the reference's cache rank map).
@@ -807,8 +730,9 @@ class ZeroEngine:
         self.pctx = dataclasses.replace(
             self.pctx, stacked_specs=stacked_specs
         )
-        if self._gather_prefetch_active:
-            # the prefetched scan needs BOTH per-layer layouts: gathered
+        self._prefetch_exec = None
+        if self._schedule.gather is not None:
+            # the scheduled gather needs BOTH per-layer layouts: gathered
             # (stacked_specs above — the gather target) and resting-
             # sharded (the gather source + the per-layer dW cotangent
             # constraint that keeps the reduce-scatter in-loop)
@@ -826,6 +750,17 @@ class ZeroEngine:
                 gather_groups=self.gather_groups,
                 stacked_shard_specs=stacked_shard,
             )
+            if self._lowering == "prefetch":
+                # legacy single-slot lowering: the GatherPrefetchScan
+                # executor passes through model.apply(sched=...) — same
+                # ctor args as the pre-scheduler pctx branch, so the
+                # traced program (and its HLO) is unchanged
+                self._prefetch_exec = _sched.GatherPrefetchScan(
+                    self.gather_prefetch, mesh, stacked_specs,
+                    stacked_shard, groups=self.gather_groups,
+                    data_axis="data",
+                    compute_dtype=model.config.compute_dtype,
+                )
         # where params LIVE between steps
         self._param_spec_rest = specs if self.stage >= 3 else base
         self._param_shardings = _to_shardings(self._param_spec_rest, mesh)
@@ -889,86 +824,21 @@ class ZeroEngine:
         # bucketed-release geometry: layer-bucket / tail-pad sizes and the
         # residual layout (raises here, at init, when grad_buckets does
         # not divide n_layer)
-        self._bucket_layout = None
-        if self._bucketed_active:
-            from .comm import bucket_layout
-            stack_dims = [s.shape[0] for nm, s in shapes.items()
-                          if nm.startswith("h.")]
-            if not stack_dims:
-                raise ValueError(
-                    "grad_buckets needs a stacked-block model (no 'h.*' "
-                    "leaves to bucket by layer)"
-                )
-            self._bucket_layout = bucket_layout(
-                shapes, stack_dims[0], self.grad_buckets, self.n_shard,
-                self.grad_comm_block,
-            )
+        # bucket / residual geometry comes from the compiled Schedule:
+        # legacy bucket lowering keeps the [b0 | ... | bK-1 | tail] row,
+        # monolithic quant the whole-tree pad, composed ZeRO-3 drops the
+        # tail slice (the tail reduce-scatters at full precision)
+        self._bucket_layout = self._schedule.layout
         self._residual_shardings = None
         self._residual_shape = None
-        if self._grad_comm_active and self.grad_comm_error_feedback:
-            if self._bucket_layout is not None:
-                # per-bucket residual slices: [b0 | ... | bK-1 | tail]
-                pad = self._bucket_layout["residual_len"]
-            else:
-                total = sum(int(np.prod(s.shape)) for s in shapes.values())
-                pad = padded_size(total, self.n_shard, self.grad_comm_block)
-            self._residual_shape = (self.n_shard, pad)
+        if self._schedule.residual_len:
+            self._residual_shape = (
+                self.n_shard, self._schedule.residual_len
+            )
             self._residual_shardings = NamedSharding(mesh, P("data"))
         self._dropout_shardings = (
             NamedSharding(mesh, P()) if self._dropout_active else None
         )
-
-        # opt-in telemetry: the health vector is part of the compiled step
-        # output, so the flag must be settled before _build_step traces
-        self.telemetry = telemetry
-        self._telemetry_on = telemetry is not None
-        if self._telemetry_on and hasattr(telemetry, "attach"):
-            telemetry.attach(self)
-        # per-layer health (Telemetry(layers=True)): the block scan taps
-        # each layer's output through parallel/comm.layer_health_tap and
-        # the step additionally returns an (n_layer, 6) layer-health
-        # matrix (telemetry/health.LAYER_FIELDS) — the first-NaN layer is
-        # localized in ONE step instead of by bisection.  Rides the plain
-        # GSPMD scan only: the explicit-schedule paths (grad_buckets,
-        # quantized grad_comm, gather_prefetch, pipeline, 1f1b) restructure
-        # the scan the probe rides, so they are rejected loudly rather
-        # than silently un-instrumented.  With layers off the compiled
-        # step is byte-identical to plain telemetry
-        # (tests/test_trace_flight.py pins the HLO).
-        self._layers_on = bool(
-            self._telemetry_on and getattr(telemetry, "layers", False)
-        )
-        self._layer_count = int(
-            getattr(getattr(model, "config", None), "n_layer", 0) or 0
-        )
-        if self._layers_on:
-            if not getattr(model, "layer_health_capable", False):
-                raise ValueError(
-                    f"{type(model).__name__} does not thread the per-layer "
-                    "health probe through its layer scan "
-                    "(layer_health_capable=False)"
-                )
-            blockers = []
-            if self.pipe_axis is not None:
-                blockers.append("pipeline_parallel")
-            if self._use_1f1b:
-                blockers.append("pipeline_schedule='1f1b'")
-            if self._bucketed_active:
-                blockers.append("grad_buckets")
-            if self._grad_comm_active:
-                blockers.append("grad_comm quantization")
-            if self._gather_prefetch_active:
-                blockers.append("gather_prefetch")
-            if blockers:
-                raise ValueError(
-                    "telemetry layers mode rides the plain layer scan; it "
-                    f"does not compose with: {', '.join(blockers)}"
-                )
-            if not self._layer_count:
-                raise ValueError(
-                    "telemetry layers mode needs a layered model "
-                    "(config.n_layer)"
-                )
 
         if self.data_parallel:
             batch_spec = P("data", self.seq_axis)  # (B, T): tokens shard too
@@ -983,8 +853,14 @@ class ZeroEngine:
 
         def _eval_impl(params, ix, tg):
             from ..ops.dispatch import gspmd_auto_region
+            kw = {}
+            if self._lowering == "prefetch":
+                # keep the legacy eval program: the forward-only pass
+                # also runs the prefetched gather scan
+                kw["sched"] = self._prefetch_exec
             with gspmd_auto_region(self.n_dev > 1):
-                return self.model.apply(params, ix, tg, pctx=self.pctx)
+                return self.model.apply(params, ix, tg, pctx=self.pctx,
+                                        **kw)
 
         # forward-only loss (validation): no dropout (no rng), no grads, no
         # state change; always takes a plain (B, T) batch (no accum axis)
@@ -1184,381 +1060,6 @@ class ZeroEngine:
         )
         return new_params, {"step": step_out, "state": new_state}
 
-    def _quant_loss_and_grads(self, state, idx, targets, rng, scale):
-        """The grad_comm != "fp32" gradient phase: local grads + explicit
-        quantized collectives inside a shard_map over the data axis
-        (parallel/comm.py module docstring for the schedule).
-
-        The model replays with pctx=None — each device sees its batch
-        shard and the full (replicated) params, exactly the SingleDevice
-        forward — so no sharding constraint inside the manual region
-        (the MoE pure-DP dispatch contract).  Microbatches accumulate
-        LOCALLY and sync once: quantizing every microbatch would compound
-        rounding error accum_steps-fold and multiply the collectives.
-
-        Returns (loss scaled+replicated, grads reduced/UNSCALED in param
-        dtypes, new (n, pad) residual or None)."""
-        from . import comm as qcomm
-
-        n = self.n_shard
-        mode = self.grad_comm
-        block = self.grad_comm_block
-        inner = self.grad_comm_groups
-        accum = self.accum_steps
-        params = state.params
-        residual = state.grad_residual
-        model = self.model
-        # stochastic-rounding stream (int8): fresh per step via the
-        # optimizer counter, decorrelated per device inside the region
-        qkey = None
-        if mode == "int8":
-            qkey = jax.random.fold_in(
-                jax.random.PRNGKey(0x6C51), state.opt_state["step"]
-            )
-        has_res, has_rng = residual is not None, rng is not None
-        has_qk, has_sc = qkey is not None, scale is not None
-
-        def local(p, ix, tg, *rest):
-            rest = list(rest)
-            res = rest.pop(0) if has_res else None
-            r = rest.pop(0) if has_rng else None
-            qk = rest.pop(0) if has_qk else None
-            sc = rest.pop(0) if has_sc else None
-            di = jax.lax.axis_index("data")
-            if r is not None:
-                # per-device fold: masks stay independent across batch
-                # shards (the GSPMD path draws one global mask stream)
-                r = jax.random.fold_in(r, di)
-            if qk is not None:
-                qk = jax.random.fold_in(qk, di)
-
-            def lloss(p_, ix_, tg_, r_):
-                kw = {"rng": r_} if r_ is not None else {}
-                loss = model.apply(p_, ix_, tg_, pctx=None, **kw)
-                return loss * sc if sc is not None else loss
-
-            if accum == 1:
-                loss_l, g = jax.value_and_grad(lloss)(p, ix, tg, r)
-            else:
-                def body(carry, mb):
-                    al, ag = carry
-                    ix_, tg_, mb_i = mb
-                    mb_r = (jax.random.fold_in(r, mb_i)
-                            if r is not None else None)
-                    l, g_ = jax.value_and_grad(lloss)(p, ix_, tg_, mb_r)
-                    ag = jax.tree.map(
-                        lambda a, b: a + b.astype(jnp.float32), ag, g_
-                    )
-                    return (al + l, ag), None
-
-                zg = jax.tree.map(
-                    lambda q: jnp.zeros(q.shape, jnp.float32), p
-                )
-                (loss_l, g), _ = jax.lax.scan(
-                    body, (jnp.zeros((), jnp.float32), zg),
-                    (ix, tg, jnp.arange(accum)),
-                )
-                loss_l = loss_l / accum
-                g = jax.tree.map(
-                    lambda a, q: (a / accum).astype(q.dtype), g, p
-                )
-            if sc is not None:
-                # unscale BEFORE the quantized sync: the residual must
-                # carry true gradient units or a dynamic-scale change
-                # between steps corrupts the compensation
-                g = jax.tree.map(
-                    lambda x: (x.astype(jnp.float32)
-                               * (1.0 / sc)).astype(x.dtype), g
-                )
-            res_row = res[0] if res is not None else None
-            g_red, res_new = qcomm.quantized_grad_sync(
-                g, res_row, "data", n, mode, block=block, rng=qk,
-                inner=inner,
-            )
-            outs = [jax.lax.pmean(loss_l, "data"), g_red]
-            if res is not None:
-                outs.append(res_new[None])
-            return tuple(outs)
-
-        pspec = jax.tree.map(lambda _: P(), params)
-        bspec = P(None, "data") if accum > 1 else P("data")
-        in_specs = [pspec, bspec, bspec]
-        args = [params, idx, targets]
-        for cond, spec, val in (
-            (has_res, P("data"), residual), (has_rng, P(), rng),
-            (has_qk, P(), qkey), (has_sc, P(), scale),
-        ):
-            if cond:
-                in_specs.append(spec)
-                args.append(val)
-        out_specs = [P(), jax.tree.map(lambda _: P(), params)]
-        if has_res:
-            out_specs.append(P("data"))
-        out = jax.shard_map(
-            local, mesh=self.mesh, in_specs=tuple(in_specs),
-            out_specs=tuple(out_specs), check_vma=False,
-        )(*args)
-        if has_res:
-            return out
-        return out[0], out[1], None
-
-    def _bucketed_loss_and_grads(self, state, idx, targets, rng, scale):
-        """The grad_buckets > 1 gradient phase: per-bucket release inside
-        the backward scan (parallel/comm.GradBucketTap).
-
-        Like _quant_loss_and_grads, everything runs inside a shard_map
-        over the data axis with the model replayed pctx=None (replicated
-        params, local batch shard).  The K layer buckets reduce INSIDE
-        the backward scan body — the tap's custom_vjp emits each bucket's
-        collective as soon as that bucket's grads are final, while
-        earlier buckets' backward compute is still in flight for the
-        scheduler to hide the wire behind.  The non-block tail
-        (wte/wpe/ln_f/lm_head) reduces once after value_and_grad: its
-        grads finalize only when the whole backward is over (wte last of
-        all), so there is no window to chase.
-
-        grad_comm="fp32" buckets pmean in compute dtype (what the GSPMD
-        all-reduce moves — comm_report round-4 finding); int8/fp8 buckets
-        run the quantized schedule with per-bucket error-feedback
-        residual slices laid out [b0 | ... | bK-1 | tail] in
-        TrainState.grad_residual (the new residual is smuggled out of the
-        backward as the tap's cotangent for the slice that rode in).
-        Microbatches accumulate LOCALLY and the buckets fire only on the
-        final microbatch — the accumulated prefix rides into the taps as
-        the "acc" extra, so the one collective per bucket reduces the
-        full mean gradient.
-
-        Returns (loss scaled+replicated, grads reduced/UNSCALED in param
-        dtypes, new (n, pad) residual or None)."""
-        from . import comm as qcomm
-
-        n = self.n_shard
-        mode = self.grad_comm
-        blk = self.grad_comm_block
-        inner = self.grad_comm_groups
-        accum = self.accum_steps
-        kb = self.grad_buckets
-        lay = self._bucket_layout
-        bpad = lay["bucket_pad"]
-        lb = lay["layers_per_bucket"]
-        tail_names = lay["tail_names"]
-        params = state.params
-        residual = state.grad_residual
-        model = self.model
-        cd = getattr(
-            getattr(model, "config", None), "compute_dtype", jnp.float32
-        )
-        qkey = None
-        if mode == "int8":
-            qkey = jax.random.fold_in(
-                jax.random.PRNGKey(0x6C51), state.opt_state["step"]
-            )
-        has_res, has_rng = residual is not None, rng is not None
-        has_qk, has_sc = qkey is not None, scale is not None
-
-        def local(p, ix, tg, *rest):
-            rest = list(rest)
-            res = rest.pop(0) if has_res else None
-            r = rest.pop(0) if has_rng else None
-            qk = rest.pop(0) if has_qk else None
-            sc = rest.pop(0) if has_sc else None
-            di = jax.lax.axis_index("data")
-            if r is not None:
-                r = jax.random.fold_in(r, di)
-            if qk is not None:
-                qk = jax.random.fold_in(qk, di)
-            res_row = res[0] if res is not None else None
-            bres = res_row[: kb * bpad] if res_row is not None else None
-            tres = res_row[kb * bpad:] if res_row is not None else None
-            bkeys = tkey = None
-            if qk is not None:
-                keys = jax.random.split(qk, kb + 1)
-                # per-bucket stochastic-rounding keys ride through the tap
-                # bitcast to f32 (integer tap inputs would need float0
-                # cotangents); the tail keeps its key directly
-                bkeys = jax.lax.bitcast_convert_type(
-                    keys[:kb], jnp.float32
-                )
-                tkey = keys[kb]
-
-            def bucket_reduce(g, ex):
-                """Tap backward: ONE bucket's collective, emitted inside
-                the backward scan body."""
-                ex_cot = {}
-                gf = jax.tree.map(lambda a: a.astype(jnp.float32), g)
-                if "acc" in ex:
-                    # final microbatch: fold in the locally-accumulated
-                    # prefix so the single sync reduces the full mean grad
-                    gf = jax.tree.map(
-                        lambda a, b: (a + b) / accum, gf, ex["acc"]
-                    )
-                    ex_cot["acc"] = jax.tree.map(
-                        jnp.zeros_like, ex["acc"]
-                    )
-                if "scale" in ex:
-                    # unscale BEFORE the sync: the residual must carry
-                    # true gradient units (the _quant_loss_and_grads
-                    # rule).  The scale rides the extras rather than the
-                    # closure — a custom_vjp bwd rule must not capture
-                    # tracers
-                    gf = jax.tree.map(
-                        lambda a: a * (1.0 / ex["scale"]), gf
-                    )
-                    ex_cot["scale"] = jnp.zeros_like(ex["scale"])
-                key = None
-                if "rng" in ex:
-                    key = jax.lax.bitcast_convert_type(
-                        ex["rng"], jnp.uint32
-                    )
-                    ex_cot["rng"] = jnp.zeros_like(ex["rng"])
-                if mode == "fp32":
-                    # compute-dtype pmean: the same bytes the GSPMD
-                    # all-reduce moves (it commutes the reduction with
-                    # the grad's f32 cast — comm_report round-4)
-                    red = jax.tree.map(
-                        lambda a, o: jax.lax.pmean(
-                            a.astype(o.dtype), "data"
-                        ), gf, g,
-                    )
-                else:
-                    red, new_r = qcomm.quantized_grad_sync(
-                        gf, ex.get("res"), "data", n, mode, block=blk,
-                        rng=key, inner=inner,
-                    )
-                    if "res" in ex:
-                        ex_cot["res"] = new_r
-                red = jax.tree.map(
-                    lambda a, o: a.astype(o.dtype), red, g
-                )
-                return red, ex_cot
-
-            def tapped_loss(p_, bres_, ix_, tg_, r_, acc=None):
-                extras = {}
-                if bres_ is not None:
-                    extras["res"] = bres_.reshape(kb, bpad)
-                if acc is not None:
-                    extras["acc"] = acc
-                if bkeys is not None:
-                    extras["rng"] = bkeys
-                if sc is not None:
-                    extras["scale"] = jnp.full((kb,), sc, jnp.float32)
-                tap = qcomm.GradBucketTap(kb, bucket_reduce, extras)
-                kw = {"rng": r_} if r_ is not None else {}
-                loss = model.apply(
-                    p_, ix_, tg_, pctx=None, grad_tap=tap, **kw
-                )
-                return loss * sc if sc is not None else loss
-
-            def run_final(ix_, tg_, r_, acc=None):
-                if bres is not None:
-                    loss_l, (gp, new_b) = jax.value_and_grad(
-                        tapped_loss, argnums=(0, 1)
-                    )(p, bres, ix_, tg_, r_, acc)
-                else:
-                    loss_l, gp = jax.value_and_grad(tapped_loss)(
-                        p, None, ix_, tg_, r_, acc
-                    )
-                    new_b = None
-                return loss_l, gp, new_b
-
-            if accum == 1:
-                loss_l, gp, new_bres = run_final(ix, tg, r)
-            else:
-                def body(carry, mb):
-                    al, ag = carry
-                    ix_, tg_, mb_i = mb
-                    mb_r = (jax.random.fold_in(r, mb_i)
-                            if r is not None else None)
-
-                    def plain(p_, ix2, tg2, r2):
-                        kw = {"rng": r2} if r2 is not None else {}
-                        loss = model.apply(p_, ix2, tg2, pctx=None, **kw)
-                        return loss * sc if sc is not None else loss
-
-                    l, g_ = jax.value_and_grad(plain)(p, ix_, tg_, mb_r)
-                    ag = jax.tree.map(
-                        lambda a, b: a + b.astype(jnp.float32), ag, g_
-                    )
-                    return (al + l, ag), None
-
-                zg = jax.tree.map(
-                    lambda q: jnp.zeros(q.shape, jnp.float32), p
-                )
-                (al, ag), _ = jax.lax.scan(
-                    body, (jnp.zeros((), jnp.float32), zg),
-                    (ix[:-1], tg[:-1], jnp.arange(accum - 1)),
-                )
-                # accumulated h.* prefix, chunked (K, L/K, ...) under the
-                # STACKED-tree keys the taps see
-                acc_blocks = {
-                    nm[len("h."):]: ag[nm].reshape(
-                        (kb, lb) + ag[nm].shape[1:]
-                    )
-                    for nm in ag if nm.startswith("h.")
-                }
-                mb_r = (jax.random.fold_in(r, accum - 1)
-                        if r is not None else None)
-                loss_f, gp, new_bres = run_final(
-                    ix[-1], tg[-1], mb_r, acc=acc_blocks
-                )
-                loss_l = (al + loss_f) / accum
-                gp = dict(gp)
-                for nm in tail_names:
-                    # the taps folded the prefix in for h.*; the tail
-                    # leaves get it here, before their own sync below
-                    gp[nm] = (
-                        (ag[nm] + gp[nm].astype(jnp.float32)) / accum
-                    ).astype(gp[nm].dtype)
-
-            # tail bucket: one sync after the backward completes
-            tail = {
-                nm: gp[nm].astype(jnp.float32) for nm in tail_names
-            }
-            if sc is not None:
-                tail = jax.tree.map(lambda a: a * (1.0 / sc), tail)
-            if mode == "fp32":
-                tail_red = jax.tree.map(
-                    lambda a: jax.lax.pmean(a.astype(cd), "data"), tail
-                )
-                new_tres = None
-            else:
-                tail_red, new_tres = qcomm.quantized_grad_sync(
-                    tail, tres, "data", n, mode, block=blk, rng=tkey,
-                    inner=inner,
-                )
-            gp = dict(gp)
-            for nm in tail_names:
-                gp[nm] = tail_red[nm]
-            grads = jax.tree.map(
-                lambda a, q: a.astype(q.dtype), gp, params
-            )
-            outs = [jax.lax.pmean(loss_l, "data"), grads]
-            if has_res:
-                outs.append(jnp.concatenate([new_bres, new_tres])[None])
-            return tuple(outs)
-
-        pspec = jax.tree.map(lambda _: P(), params)
-        bspec = P(None, "data") if accum > 1 else P("data")
-        in_specs = [pspec, bspec, bspec]
-        args = [params, idx, targets]
-        for cond, spec, val in (
-            (has_res, P("data"), residual), (has_rng, P(), rng),
-            (has_qk, P(), qkey), (has_sc, P(), scale),
-        ):
-            if cond:
-                in_specs.append(spec)
-                args.append(val)
-        out_specs = [P(), jax.tree.map(lambda _: P(), params)]
-        if has_res:
-            out_specs.append(P("data"))
-        out = jax.shard_map(
-            local, mesh=self.mesh, in_specs=tuple(in_specs),
-            out_specs=tuple(out_specs), check_vma=False,
-        )(*args)
-        if has_res:
-            return out
-        return out[0], out[1], None
-
     def _step_impl(self, state: "TrainState", batch):
         # trace-time marker: on a multi-device mesh this program is GSPMD
         # auto-partitioned, so naked Mosaic custom calls cannot lower —
@@ -1587,18 +1088,24 @@ class ZeroEngine:
         # per-layer health probe (telemetry layers mode): a zeros (L, 4)
         # array differentiated alongside the params — its "gradient" is
         # the per-layer activation/activation-gradient stats smuggled out
-        # of the scan by parallel/comm.layer_health_tap
+        # of the scan by parallel/schedule.layer_health_tap
         probe0 = None
         if self._layers_on:
-            from .comm import LAYER_PROBE_WIDTH
+            from .schedule import LAYER_PROBE_WIDTH
             probe0 = jnp.zeros(
                 (self._layer_count, LAYER_PROBE_WIDTH), jnp.float32
             )
 
         def loss_fn(p, ix, tg, rng=None, probe=None):
+            from .schedule import ProbeScan
             kw = {"rng": rng} if rng is not None else {}
             if probe is not None:
-                kw["health_probe"] = probe
+                # probe lowering: the executor adds the (L, 4) probe row
+                # to the stacked scan tree — the plain-scan program is
+                # byte-identical to the pre-scheduler health_probe= path
+                kw["sched"] = ProbeScan(probe)
+            elif self._lowering == "prefetch":
+                kw["sched"] = self._prefetch_exec
             l = self.model.apply(p, ix, tg, pctx=self.pctx, **kw)
             # loss scaling happens INSIDE the differentiated fn so the
             # whole backward runs on scaled values (fp16 AMP)
@@ -1625,22 +1132,35 @@ class ZeroEngine:
 
         new_residual = state.grad_residual
         layer_probe = None
-        if self._bucketed_active:
+        if self._lowering == "composed":
+            # the merged scheduler machine (parallel/schedule.py): every
+            # declared slot — explicit prefetched/hpZ gathers, bucketed
+            # quantized releases, the health probe — in ONE custom_vjp
+            # scan pair inside a shard_map over the data axis.  Grads
+            # come back reduced and UNSCALED like the legacy explicit
+            # paths below.
+            from .schedule import composed_step
+            loss, grads, new_residual, layer_probe = composed_step(
+                self, state, idx, targets, rng, scale
+            )
+        elif self._lowering == "bucket":
             # bucketed backward-overlapped release (grad_buckets > 1):
             # per-bucket collectives emitted inside the backward scan
             # body, fp32 or quantized.  Grads come back reduced and
             # UNSCALED, like the quantized path below.
-            loss, grads, new_residual = self._bucketed_loss_and_grads(
-                state, idx, targets, rng, scale
+            from .schedule import bucketed_step
+            loss, grads, new_residual = bucketed_step(
+                self, state, idx, targets, rng, scale
             )
-        elif self._grad_comm_active:
+        elif self._lowering == "quant_mono":
             # quantized gradient collectives (parallel/comm.py): local
             # grads inside a shard_map over the data axis, explicit
             # error-feedback int8/fp8 reduce-scatter + all-gather.  Grads
             # come back UNSCALED (the residual must live in true gradient
             # units); the loss is still scaled like the GSPMD path.
-            loss, grads, new_residual = self._quant_loss_and_grads(
-                state, idx, targets, rng, scale
+            from .schedule import monolithic_quant_step
+            loss, grads, new_residual = monolithic_quant_step(
+                self, state, idx, targets, rng, scale
             )
         elif self.accum_steps == 1:
             loss, grads, layer_probe = loss_and_grads(
@@ -1707,7 +1227,9 @@ class ZeroEngine:
 
         if scale is not None:
             loss = loss / scale
-            if not (self._grad_comm_active or self._bucketed_active):
+            if self._lowering in ("plain", "probe", "prefetch"):
+                # the explicit-schedule lowerings (composed / bucket /
+                # quant_mono) already unscaled before their collectives
                 grads = _rescale(grads, 1.0 / scale)
             if layer_probe is not None:
                 # the backward ran on the scaled loss: the dact sq-sum
@@ -1908,6 +1430,10 @@ class ZeroEngine:
             extras += f", gather_prefetch={self.gather_prefetch}"
             if self.gather_groups:
                 extras += f"(2-hop inner={self.gather_groups})"
+        if getattr(self, "hpz", False):
+            extras += ", hpz=on"
+        if getattr(self, "_lowering", "plain") not in ("plain",):
+            extras += f", sched={self._schedule.describe()}"
         return (
             f"{name}(stage={self.stage}, devices={self.n_dev}, "
             f"accum={self.accum_steps}, params sharded="

@@ -175,11 +175,12 @@ class ParallelContext:
     # partitioner fuses the dequant multiply shard-side and gathers full
     # precision).  None outside an engine.
     stacked_specs: _Optional[dict] = None
-    # ZeRO-3 layer-ahead weight-gather prefetch (engine gather_prefetch=,
-    # parallel/comm.GatherPrefetchScan): >= 2 switches the model's layer
-    # scan to the explicit prefetched gather holding at most this many
-    # layers' gathered weights (2 = double buffer).  0/1 = the plain
-    # GSPMD gather-on-demand scan (byte-identical program).
+    # ZeRO-3 layer-ahead weight-gather prefetch depth (engine
+    # gather_prefetch=).  Informational since the scheduler refactor:
+    # the model no longer branches on it — the engine builds the gather
+    # slot's executor (parallel/schedule.GatherPrefetchScan or the
+    # composed machine) and passes it through model.apply(sched=);
+    # kept on the context for introspection/compat.
     gather_prefetch: int = 0
     # hierarchical 2-hop gather: that many consecutive ranks per
     # resting-precision intra-group hop, compute dtype across groups

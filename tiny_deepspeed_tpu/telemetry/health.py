@@ -75,7 +75,7 @@ def health_dict(vec) -> Dict[str, float]:
 # ---------------------------------------------------------------------------
 
 # column order of the (n_layer, 6) layer-health matrix.  The first four
-# come from the in-scan probe tap (parallel/comm.layer_health_tap: forward
+# come from the in-scan probe tap (parallel/schedule.layer_health_tap: forward
 # activation stats + backward activation-gradient stats); the last two are
 # computed from the stacked "h.*" gradient leaves after the backward (the
 # stacked layout already carries the per-layer split — no tap needed).

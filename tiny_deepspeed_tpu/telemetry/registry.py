@@ -504,6 +504,31 @@ class Telemetry:
                 "gather_async_windows_overlapped",
             )
         }
+        # composed scheduler (parallel/schedule.py): per-slot overlap
+        # view of the MERGED program, plus the hpZ acceptance gauge —
+        # loop-resident gather wire that crosses DCN (~zero when the
+        # secondary weight partition keeps in-scan gathers intra-slice)
+        if getattr(engine, "_lowering", "plain") == "composed":
+            sched = engine._schedule
+            if sched.gather is not None:
+                self.gauge(
+                    "sched_gather_overlap_frac",
+                    overlap["gather_overlap_frac"],
+                )
+            if sched.grad is not None:
+                self.gauge(
+                    "sched_grad_overlap_frac",
+                    overlap["grad_comm_overlap_frac"],
+                )
+            if granule_of is not None:
+                from ..utils.hlo_comm import gather_link_split_in_loops
+                in_scan = gather_link_split_in_loops(led, granule_of)
+                measured["wire_bytes_by_link_in_scan_gather"] = in_scan
+                if sched.gather is not None and sched.gather.hpz:
+                    self.gauge(
+                        "hpz_dcn_wire_bytes",
+                        in_scan["dcn_wire_bytes"],
+                    )
         modeled = float(model_rep.get("total_bytes_per_step", 0.0))
         if modeled > 0:
             out["comm_delta"] = round(

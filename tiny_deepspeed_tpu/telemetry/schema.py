@@ -83,7 +83,19 @@ _NUM = (int, float)
 #      candidates that refused their shapes — emitted only when tuner
 #      diagnostics are attached, so tuner-less files stay
 #      byte-compatible with v9 readers
-SCHEMA_VERSION = 10
+#  11: + the in-scan collective scheduler (parallel/schedule.py): on
+#      engines whose schedule lowers to the composed multi-slot machine,
+#      capture_compiled additionally gauges the per-slot overlap view —
+#      sched_gather_overlap_frac / sched_grad_overlap_frac (loop-resident
+#      wire per slot family on the MERGED program) — and under hpZ the
+#      hpz_dcn_wire_bytes gauge (the loop-resident all-gather wire that
+#      crosses a DCN granule: ~zero when the secondary weight partition
+#      keeps every in-scan gather intra-slice, ZeRO++ arXiv:2306.10209);
+#      run_meta's comm_measured gains gather_link_split_in_loops under
+#      `wire_bytes_by_link_in_scan_gather` on hybrid meshes — all
+#      emitted only by scheduler-composed engines, so single-slot files
+#      stay byte-compatible with v10 readers
+SCHEMA_VERSION = 11
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -464,6 +476,23 @@ GAUGES: Dict[str, str] = {
                       "processes) on the hybrid mesh — measured from "
                       "the compiled HLO's replica_groups, not modeled "
                       "(utils/hlo_comm.wire_link_split)",
+    "sched_gather_overlap_frac": "composed scheduler (parallel/"
+                                 "schedule.py): loop-resident / total "
+                                 "all-gather wire on the MERGED "
+                                 "multi-slot program — the gather "
+                                 "slot's overlap view",
+    "sched_grad_overlap_frac": "composed scheduler: loop-resident / "
+                               "total reducing-collective wire on the "
+                               "merged program — the grad slot's "
+                               "overlap view (bucket releases inside "
+                               "the backward scan)",
+    "hpz_dcn_wire_bytes": "loop-resident (in-scan) all-gather wire "
+                          "whose replica groups cross a DCN granule "
+                          "(utils/hlo_comm.gather_link_split_in_loops) "
+                          "— ~zero under hpZ secondary weight "
+                          "partitioning, where every in-scan gather "
+                          "stays intra-slice and only the one "
+                          "top-level secondary rebuild crosses DCN",
     "serve_spec_accept_rate": "speculative decoding: drafts accepted / "
                               "drafts proposed, engine lifetime — the "
                               "drafter-quality number that decides "

@@ -405,6 +405,12 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
     # wire keyed by the collective's PARTICIPANT groups (None = encoding
     # unrecognized) — what wire_link_split classifies as ICI vs DCN
     wire_by_groups: Dict[object, float] = {}
+    # the same keying, but per OP and restricted to LOOP-RESIDENT
+    # collectives — what lets wire_link_split answer "which link do the
+    # IN-SCAN gathers ride" (the hpZ acceptance: in-scan gather DCN
+    # bytes ~zero while the top-level secondary-partition rebuild still
+    # crosses DCN)
+    wire_by_op_groups_in_loops: Dict[str, Dict[object, float]] = {}
 
     def walk(comp: str, mult: float, seen: tuple,
              in_loop: bool = False) -> None:
@@ -434,6 +440,8 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
                 # (overlap_report builds on this split)
                 wire_in_loops[op] = wire_in_loops.get(op, 0.0) + mult * w
                 count_in_loops[op] = count_in_loops.get(op, 0.0) + mult
+                per_grp = wire_by_op_groups_in_loops.setdefault(op, {})
+                per_grp[members] = per_grp.get(members, 0.0) + mult * w
             if b:
                 # the ring formulas above are linear in the payload, so
                 # the per-dtype wire split is just proportional; kept both
@@ -461,6 +469,7 @@ def collective_ledger(compiled_text: str) -> Dict[str, object]:
         "wire_bytes_in_loops": wire_in_loops,
         "count_in_loops": count_in_loops,
         "wire_bytes_by_groups": wire_by_groups,
+        "wire_bytes_by_op_groups_in_loops": wire_by_op_groups_in_loops,
         "total_wire_bytes": sum(wire.values()),
         "unresolved_loops": unresolved,
         "unresolved_groups": unresolved_groups,
@@ -649,6 +658,25 @@ def wire_link_split(led: Dict[str, object],
         "unresolved_wire_bytes": float(unresolved),
         "dcn_crossing_collectives": len(dcn_groups),
     }
+
+
+def gather_link_split_in_loops(led: Dict[str, object],
+                               granule_of: Dict[int, int]
+                               ) -> Dict[str, float]:
+    """ICI-vs-DCN split of the LOOP-RESIDENT all-gather wire only — the
+    in-scan weight gathers.  This is the hpZ acceptance number (ZeRO++
+    arXiv:2306.10209): with the secondary weight partition, every
+    forward/backward gather inside the block scan rides the intra-slice
+    group (ICI) and `dcn_wire_bytes` here drops to ~zero, while the ONE
+    top-level inter-slice rebuild of the secondary partition still
+    (correctly) crosses DCN and stays visible in the full
+    `wire_link_split`."""
+    per_op = led.get("wire_bytes_by_op_groups_in_loops", {})
+    merged: Dict[object, float] = {}
+    for op in _GATHER_OPS:
+        for members, w in per_op.get(op, {}).items():
+            merged[members] = merged.get(members, 0.0) + w
+    return wire_link_split({"wire_bytes_by_groups": merged}, granule_of)
 
 
 def ledger_summary(led: Dict[str, object],

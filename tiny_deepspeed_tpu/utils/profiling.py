@@ -329,7 +329,7 @@ def comm_report(engine) -> Dict[str, float]:
                 quant_wire_bytes=k * qb["quant_wire_bytes"]
                 + qt["quant_wire_bytes"],
             )
-    # gather_prefetch (parallel/comm.GatherPrefetchScan): the explicit
+    # gather_prefetch (parallel/schedule.GatherPrefetchScan): the explicit
     # prefetched schedule issues K-1 extra clamped end-of-scan gathers
     # per pass (fwd + remat bwd each run L+K-1 layer gathers), and
     # gather_groups reroutes each layer's gather through the 2-hop
