@@ -38,7 +38,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-MAX_ATTEMPTS = int(os.environ.get("BENCH_MAX_ATTEMPTS", "5"))
+# Backend-probe retries: default is now ONE attempt — fail fast with the
+# probe verdict stamped in extra.backend_probe (BENCH_r02-r05 each burned
+# ~4 minutes in 5 escalating retries against a tunnel that stayed dead for
+# hours; the last-good cache below answers the "but it WAS measured"
+# case).  TINY_DS_PROBE_RETRIES (or the older BENCH_MAX_ATTEMPTS) restores
+# the escalating-backoff behavior.
+MAX_ATTEMPTS = int(os.environ.get(
+    "TINY_DS_PROBE_RETRIES", os.environ.get("BENCH_MAX_ATTEMPTS", "1")))
 
 # Last-good cache: the observed tunnel outages last HOURS while the retry
 # budget above spans ~12 minutes, so a round-end outage used to guarantee a
@@ -337,13 +344,11 @@ def _retry_or_diagnose(exc: BaseException) -> None:
 
 
 def _peak_flops_per_chip(device) -> float:
-    """bf16 peak by device kind (used only for the MFU context numbers)."""
-    kind = getattr(device, "device_kind", "").lower()
-    for tag, peak in (("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
-                      ("v6", 918e12), ("v4", 275e12)):
-        if tag in kind:
-            return peak
-    return 197e12
+    """bf16 peak by device kind (used only for the MFU context numbers).
+    Delegates to the cost ledger's table (utils/hlo_cost.py) so the MFU
+    denominator and the roofline verdict can never disagree."""
+    from tiny_deepspeed_tpu.utils.hlo_cost import peak_flops_per_chip
+    return peak_flops_per_chip(getattr(device, "device_kind", ""))
 
 
 def measure(engine, state, batch, warmup=5, iters=30):
@@ -729,21 +734,61 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     n_active = n_params
     from tiny_deepspeed_tpu.models.moe import MoEConfig
     if isinstance(cfg, MoEConfig):
-        # routed experts: only top_k of n_expert run per token — counting
-        # all expert params would overstate FLOPs ~E/k-fold
+        # routed experts: only top_k of n_expert run per token — but the
+        # capacity-padded dispatch feeds every expert its FULL C slots
+        # (round 16, HLO-counted: E*C = cf*k*S slot-rows of compute, a
+        # capacity_factor more than the k/E accounting claimed — both
+        # dispatch paths pad to (E, C, D))
         import math as _math
         expert = sum(
             int(_math.prod(s.shape))
             for n, s in model.param_shapes().items()
             if ".moe." in n and "router" not in n
         )
-        n_active = (n_params - expert
-                    + expert * cfg.expert_top_k // cfg.n_expert)
+        _cap = max(1, int(cfg.capacity_factor * cfg.expert_top_k
+                          * b * t / cfg.n_expert))
+        # E*C slot-rows each through expert/E params: per token the
+        # expert params "active" are expert * C / S
+        n_active = n_params - expert + expert * _cap // (b * t)
     flops_tok_matmul = 6 * (n_active - embed_params) + 12 * l * t * d
+    if isinstance(cfg, MoEConfig) and moe_eff == "einsum":
+        # round 16: the GShard dispatch/combine einsums are real model
+        # matmuls (~2/3 of the expert FLOPs at this shape) that the
+        # formula above ignored — the HLO counter demonstrated the
+        # undercount (tests/test_hlo_cost.py) and this corrects it
+        from tiny_deepspeed_tpu.models.moe import (
+            dispatch_combine_flops_per_token,
+        )
+        flops_tok_matmul += dispatch_combine_flops_per_token(cfg, b * t)
     peak = _peak_flops_per_chip(devices[0])
     toks_per_sec_total = b * t / step_time
     matmul_mfu = flops_tok_matmul * toks_per_sec_total / n_chips / peak
     mfu_6n = 6 * n_params * toks_per_sec_total / n_chips / peak
+
+    # HLO cost ledger (utils/hlo_cost.py): measured FLOPs/HBM + roofline
+    # verdict off the ALREADY-compiled step — stamped on the record so
+    # every future round is self-describing (perf_diff reads mfu_hlo to
+    # flag modeled-vs-measured drift).  Best effort: never the headline.
+    hlo_cost_extra = None
+    try:
+        from tiny_deepspeed_tpu.utils.hlo_comm import collective_ledger
+        from tiny_deepspeed_tpu.utils.hlo_cost import (
+            cost_ledger, cost_summary,
+        )
+        _ctext = compiled_step.as_text()
+        _cled = cost_ledger(_ctext)
+        hlo_cost_extra = cost_summary(
+            _cled,
+            device_kind=getattr(devices[0], "device_kind", None),
+            wire_bytes=float(collective_ledger(_ctext).get(
+                "total_wire_bytes", 0.0)),
+        )
+        # per-device program FLOPs over the measured step wall
+        hlo_cost_extra["mfu_hlo"] = round(
+            hlo_cost_extra["total_flops"] / step_time / peak, 3)
+    except Exception as e:  # noqa: BLE001 - observability is non-fatal
+        print(f"bench: hlo cost ledger failed: {e!r:.200}",
+              file=sys.stderr)
 
     # telemetry sidecar: measured collective ledger + a few instrumented
     # steps, so scripts/report_run.py can render this bench run.  Best
@@ -759,6 +804,7 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         _write_bench_telemetry(
             tel_path, engine, state, (idx, tgt), compiled_step.as_text(),
             model_name, n_chips, b, t, peak,
+            flops_tok_matmul=flops_tok_matmul, hlo_cost=hlo_cost_extra,
         )
     except Exception as e:  # noqa: BLE001 - observability is non-fatal
         print(f"bench: telemetry sidecar failed: {e!r:.200}",
@@ -778,6 +824,7 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             "step_time_s": round(step_time, 4),
             "matmul_mfu": round(matmul_mfu, 3),
             "mfu_6n": round(mfu_6n, 3),
+            **({"hlo_cost": hlo_cost_extra} if hlo_cost_extra else {}),
             "peak_hbm_gb_per_chip": hbm_gb,
             "n_params_m": round(n_params / 1e6, 1),
             # what actually ran, so an A/B record can't claim a knob value
@@ -1525,7 +1572,7 @@ def _vs_prev_round(value: float) -> float:
 
 def _write_bench_telemetry(path, engine, state, batch, compiled_text,
                            model_name, n_chips, b, t, peak_flops,
-                           steps=5):
+                           steps=5, flops_tok_matmul=None, hlo_cost=None):
     """Telemetry sidecar for the bench record: a run_meta line (measured
     HLO-ledger collective bytes next to the comm_report model, AOT-known
     geometry) plus a few instrumented per-step records — written AFTER the
@@ -1556,12 +1603,30 @@ def _write_bench_telemetry(path, engine, state, batch, compiled_text,
             tokens_per_step=b * t, peak_flops_per_chip=peak_flops,
             comm_model=comm_report(engine), comm_measured=measured,
             comm_overlap=overlap,
+            # measured vs analytic compute accounting side by side —
+            # report_run prefers the measured one for MFU, perf_diff
+            # flags their divergence (formula rot)
+            **({"flops_per_token_matmul": float(flops_tok_matmul)}
+               if flops_tok_matmul is not None else {}),
+            **({"hlo_cost": hlo_cost} if hlo_cost else {}),
         )
         # step-trace span template: trace_view.py renders the sidecar's
         # timeline without recompiling the step
+        cost_loops = None
+        if hlo_cost:
+            from tiny_deepspeed_tpu.telemetry.trace import (
+                compute_span_template,
+            )
+            from tiny_deepspeed_tpu.utils.hlo_cost import cost_ledger
+            _cl = cost_ledger(compiled_text)
+            cost_loops = compute_span_template(
+                [lo for lo in _cl["loops"] if lo.get("flops", 0.0) > 0],
+                float(_cl["total_flops"]),
+            )
         ml.log_meta(
             kind="trace",
             spans=collective_span_template(measured),
+            **({"compute_spans": cost_loops} if cost_loops else {}),
         )
         for i in range(steps):
             with timer.step() as tm:

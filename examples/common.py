@@ -784,12 +784,19 @@ def run(engine_cls, args, single_device=False):
                 seq_len=args.seq_len, tokens_per_step=b * args.seq_len,
             ))
             spans = telem.trace_spans()
-            if spans:
+            cspans = telem.compute_trace_spans()
+            if spans or cspans:
                 # step-trace span template (telemetry/trace.py): the
                 # compiled step's collectives by (op, loop residency)
-                # with exact ledger wire bytes — scripts/trace_view.py
-                # joins it with the per-step wall segments above
-                metrics.log_meta(kind="trace", spans=spans)
+                # with exact ledger wire bytes, plus the compute spans
+                # sized by HLO-counted FLOPs (utils/hlo_cost.py) —
+                # scripts/trace_view.py joins both with the per-step
+                # wall segments above
+                metrics.log_meta(
+                    kind="trace",
+                    **({"spans": spans} if spans else {}),
+                    **({"compute_spans": cspans} if cspans else {}),
+                )
         if ran:
             # per-host straggler attribution over the UNCOUPLED host-side
             # prep wall (data load + staging): collectives equalize the

@@ -108,9 +108,25 @@ def render_report(metas: List[dict], steps: List[dict],
         peak = run.get("peak_flops_per_chip")
         n_params = run.get("n_params")
         devices = run.get("devices", 1) or 1
-        if peak and n_params:
+        # MFU accounting preference: HLO-counted (measured numerator,
+        # utils/hlo_cost) > analytic matmul (bench's honest formula) >
+        # 6N naive (self-flattering: prices embedding gathers as
+        # matmul FLOPs) — always labeled with which one was used
+        cost = run.get("hlo_cost") or {}
+        tok_step = run.get("tokens_per_step")
+        fptm = run.get("flops_per_token_matmul")
+        if peak and cost.get("total_flops") and tok_step:
+            # per-device program FLOPs x steps/s / per-chip peak
+            mfu = (float(cost["total_flops"]) * mean_tps
+                   / float(tok_step) / peak)
+            out.append(f"- MFU (HLO-counted): {mfu:.3f}")
+        elif peak and fptm:
+            mfu = float(fptm) * mean_tps / devices / peak
+            out.append(f"- MFU (matmul accounting): {mfu:.3f}")
+        elif peak and n_params:
             mfu = 6 * n_params * mean_tps / devices / peak
-            out.append(f"- MFU (6N): {mfu:.3f}")
+            out.append(f"- MFU (6N naive; no measured accounting "
+                       f"in file): {mfu:.3f}")
     out.append("")
 
     # -- step-time breakdown ------------------------------------------------
@@ -175,6 +191,45 @@ def render_report(metas: List[dict], steps: List[dict],
                     f"WARNING: {unresolved} collective(s)/loop(s) had "
                     "unresolved attribution — totals are a lower bound\n"
                 )
+
+    # -- roofline (HLO cost ledger) -----------------------------------------
+    cost = run.get("hlo_cost")
+    if cost:
+        out.append("## Roofline (per device per step, "
+                   "`utils/hlo_cost.py`)\n")
+        out.append(f"- FLOPs: {cost.get('total_flops', 0.0):.3e} "
+                   f"({cost.get('flops_in_loops', 0.0):.3e} in loops)")
+        out.append(f"- HBM traffic (modeled): "
+                   f"{_fmt_bytes(cost.get('hbm_bytes', 0.0))}")
+        if cost.get("wire_bytes"):
+            out.append(f"- wire traffic: "
+                       f"{_fmt_bytes(cost['wire_bytes'])}")
+        ai = cost.get("arithmetic_intensity", 0.0)
+        ridge = cost.get("ridge_intensity", 0.0)
+        out.append(f"- arithmetic intensity: {ai:.1f} FLOPs/byte "
+                   f"(device ridge {ridge:.1f})")
+        bound = cost.get("bound", "?")
+        out.append(
+            f"- bound verdict: **{bound}-bound** "
+            f"(t_compute {cost.get('t_compute_s', 0.0) * 1e3:.2f} ms, "
+            f"t_hbm {cost.get('t_hbm_s', 0.0) * 1e3:.2f} ms, "
+            f"t_wire {cost.get('t_wire_s', 0.0) * 1e3:.2f} ms lower "
+            f"bounds)"
+        )
+        centers = cost.get("top_cost_centers") or []
+        if centers:
+            out.append("\ntop cost centers:\n")
+            out.append("| op (result <- operands) | FLOPs | ops/step "
+                       "| share |")
+            out.append("|---|---|---|---|")
+            for c in centers:
+                out.append(
+                    f"| `{c.get('sig', '?')}` | "
+                    f"{c.get('flops', 0.0):.3e} | "
+                    f"{c.get('count', 0.0):.0f} | "
+                    f"{c.get('share', 0.0):.0%} |"
+                )
+        out.append("")
 
     # -- memory -------------------------------------------------------------
     hbm_peak = _col(steps, "hbm_gb_peak")

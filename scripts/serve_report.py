@@ -137,6 +137,26 @@ def render_serve_report(metas: List[dict], source: str = "") -> str:
         if serve:
             out.append("- serve config: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(serve.items())))
+        # roofline verdict from the HLO cost ledger (utils/hlo_cost),
+        # when the run captured one — decode programs are the textbook
+        # hbm-bound case (weights re-read every token), so the verdict
+        # + top cost center name WHAT dominates the token loop
+        cost = run.get("hlo_cost") or {}
+        if cost.get("bound"):
+            out.append(
+                f"- roofline: **{cost['bound']}-bound** "
+                f"(AI {cost.get('arithmetic_intensity', 0.0):.1f} "
+                f"FLOPs/byte vs ridge "
+                f"{cost.get('ridge_intensity', 0.0):.1f}; "
+                f"{cost.get('total_flops', 0.0):.2e} FLOPs, "
+                f"{cost.get('hbm_bytes', 0.0):.2e} HBM bytes per "
+                f"program)"
+            )
+            centers = cost.get("top_cost_centers") or []
+            for c in centers[:3]:
+                out.append(
+                    f"  - {c.get('share', 0.0):.0%} `{c.get('sig', '?')}`"
+                )
         out.append("")
 
     # -- outcomes -----------------------------------------------------------

@@ -482,3 +482,56 @@ def test_scheduler_schema_v11_names():
             f"{name} gone from utils/hlo_comm.py — the hpZ in-scan DCN "
             "pin reads it"
         )
+
+
+def test_hlo_cost_schema_v12_names():
+    """Schema-v12 drift guard (the HLO cost ledger): the roofline gauges
+    must stay documented AND registered by telemetry/registry
+    capture_compiled, and utils/hlo_cost.py must keep the entry points
+    the reports and bench read."""
+    from tiny_deepspeed_tpu.telemetry import schema
+
+    assert schema.SCHEMA_VERSION >= 12
+    v12_gauges = {"hlo_flops", "hlo_hbm_bytes", "step_mfu_hlo",
+                  "arithmetic_intensity"}
+    assert v12_gauges <= set(schema.GAUGES), (
+        v12_gauges - set(schema.GAUGES))
+    assert schema.META_FIELDS.get("hlo_cost") is dict
+    assert "compute_spans" in schema.META_FIELDS
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "telemetry", "registry.py")) as f:
+        reg_src = f.read()
+    for g in sorted(v12_gauges):
+        assert f'"{g}"' in reg_src, (
+            f"gauge {g} documented in schema but no longer registered "
+            "by telemetry/registry.py capture_compiled"
+        )
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "utils", "hlo_cost.py")) as f:
+        cost_src = f.read()
+    for name in ("cost_ledger", "cost_summary", "roofline_verdict",
+                 "peak_flops_per_chip"):
+        assert name in cost_src, (
+            f"{name} gone from utils/hlo_cost.py — reports, bench and "
+            "the registry read it"
+        )
+
+
+def test_perf_diff_check_committed_trajectory():
+    """CI wiring for the perf regression sentinel: `perf_diff --check`
+    must run green against the committed BENCH_*.json trajectory.  A
+    nonzero exit here means either a real cross-round regression was
+    committed or the sentinel itself broke — both block the PR."""
+    import glob
+    import sys
+
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+    assert rounds, "no committed BENCH_*.json rounds to gate on"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_diff.py"),
+         "--check", *rounds],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert proc.returncode == 0, (
+        f"perf_diff --check flagged the committed trajectory:\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )

@@ -50,8 +50,12 @@ class MoEConfig(GPTConfig):
     # parallelism) or "sort" (argsort tokens by expert, gather rows into
     # (E, C, D), scatter-add back).  The einsum pair costs 2*2*S*(E*C)*D
     # FLOPs per layer — at moe-8x124m bench shape ~2/3 of the expert
-    # matmul FLOPs themselves, none of it counted as model compute — while
-    # the sort path moves the same rows with O(S*k log) sort + gather.
+    # matmul FLOPs themselves — while the sort path moves the same rows
+    # with O(S*k log) sort + gather.  Round 16: the einsum cost IS now
+    # counted as model compute — `dispatch_combine_flops_per_token`
+    # below feeds bench's flops_tok_matmul when the effective dispatch
+    # is einsum, and tests/test_hlo_cost.py pins the analytic number
+    # against the HLO-counted FLOPs of the compiled step.
     # "sort" runs single-device and — round 5 — SHARD-LOCAL under pure
     # data parallelism (experts replicated: each device argsorts its own
     # token shard inside a shard_map, capacity prorated by shard, zero
@@ -78,6 +82,29 @@ def effective_dispatch(cfg, pctx) -> str:
             or pctx.seq_parallel or pctx.pipe_parallel):
         return "einsum"
     return "sort"
+
+
+def dispatch_combine_flops_per_token(cfg, panel_tokens: int) -> float:
+    """Analytic TRAIN FLOPs per token of the einsum dispatch/combine pair
+    across all layers — the undercount the MoEConfig docstring used to
+    only apologize for.
+
+    Per layer the compiled step runs FIVE S-contracting matmuls of
+    2*S*E*C*D FLOPs each: dispatch ("sec,sd->ecd") + combine
+    ("sec,ecd->sd") forward, then THREE backward — d_xs from the
+    dispatch einsum, d_combine and d_ye from the combine einsum.  The
+    dispatch one-hot's own cotangent is dead (routing reaches it through
+    argmax; only `combine` carries the differentiable gates), so the
+    naive 3x-forward rule's sixth matmul never exists.  Divided by the S
+    tokens of the routing panel: 10 * n_layer * E * C * D per token,
+    with C the same capacity expression `_route` computes from
+    `panel_tokens` (= b*t single-device; the per-shard panel under dp
+    sharding).  Only the einsum path pays this — `effective_dispatch`
+    says whether it runs.  tests/test_hlo_cost.py pins this formula
+    against the HLO-counted FLOPs of the compiled moe step."""
+    e, k = cfg.n_expert, cfg.expert_top_k
+    cap = max(1, int(cfg.capacity_factor * k * panel_tokens / e))
+    return 10.0 * cfg.n_layer * e * cap * cfg.n_embd
 
 
 # Entry-point presets (one flat namespace with gpt2-*/llama-*,

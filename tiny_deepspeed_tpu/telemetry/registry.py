@@ -156,6 +156,9 @@ class Telemetry:
         self.trace_path: Optional[str] = None
         self._trace_logged = False
         self._comm: Optional[Dict[str, object]] = None
+        # per-layer loop attribution from the last cost ledger
+        # (capture_compiled) — the source of trace_view's compute spans
+        self._cost_loops: Optional[list] = None
 
     # -- registry -----------------------------------------------------------
 
@@ -557,6 +560,41 @@ class Telemetry:
             self.gauge("aot_temp_bytes", mem.temp_size_in_bytes)
         except Exception:
             pass
+        # compute/HBM cost ledger (utils/hlo_cost.py): the roofline's
+        # other two axes, read off the SAME compiled text as the wire
+        # ledger — post-hoc analysis only, the cached step is untouched
+        from ..utils.hlo_cost import (
+            cost_ledger, cost_summary, peak_flops_per_chip,
+        )
+        cled = cost_ledger(compiled_text)
+        dev_kind = None
+        try:
+            mesh = getattr(engine, "mesh", None)
+            dev = (mesh.devices.flatten()[0] if mesh is not None
+                   else jax.devices()[0])
+            dev_kind = getattr(dev, "device_kind", None)
+        except Exception:
+            pass
+        cost = cost_summary(
+            cled, device_kind=dev_kind,
+            wire_bytes=float(measured.get("total_wire_bytes", 0.0)),
+        )
+        out["hlo_cost"] = cost
+        self.gauge("hlo_flops", cost["total_flops"])
+        self.gauge("hlo_hbm_bytes", cost["hbm_bytes"])
+        self.gauge("arithmetic_intensity", cost["arithmetic_intensity"])
+        if self.timer.times:
+            step_s = float(np.median(np.asarray(self.timer.times)))
+            if step_s > 0:
+                self.gauge(
+                    "step_mfu_hlo",
+                    cost["total_flops"] / step_s
+                    / peak_flops_per_chip(dev_kind),
+                )
+        # per-layer attribution for trace_view's compute spans
+        self._cost_loops = [
+            dict(l) for l in cled["loops"] if l.get("flops", 0.0) > 0
+        ]
         self._comm = out
         return out
 
@@ -593,6 +631,19 @@ class Telemetry:
             return None
         from .trace import collective_span_template
         return collective_span_template(self._comm["comm_measured"])
+
+    def compute_trace_spans(self) -> Optional[list]:
+        """Schematic FLOP-sized compute span template from the last
+        `capture_compiled` cost ledger (utils/hlo_cost loop attribution),
+        or None before one ran — trace_view renders these next to the
+        wire-sized collective spans."""
+        if not self._comm or "hlo_cost" not in self._comm:
+            return None
+        from .trace import compute_span_template
+        return compute_span_template(
+            self._cost_loops or [],
+            float(self._comm["hlo_cost"]["total_flops"]),
+        )
 
     # -- sinks --------------------------------------------------------------
 

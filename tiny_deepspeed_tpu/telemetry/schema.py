@@ -95,7 +95,22 @@ _NUM = (int, float)
 #      `wire_bytes_by_link_in_scan_gather` on hybrid meshes — all
 #      emitted only by scheduler-composed engines, so single-slot files
 #      stay byte-compatible with v10 readers
-SCHEMA_VERSION = 11
+#  12: + the HLO cost ledger (utils/hlo_cost.py): capture_compiled
+#      additionally gauges hlo_flops / hlo_hbm_bytes (compute FLOPs and
+#      modeled HBM traffic counted from the compiled step's post-SPMD
+#      HLO, loop-multiplied), arithmetic_intensity (their ratio), and —
+#      when step timings exist — step_mfu_hlo (HLO-counted MFU, the
+#      measured-numerator replacement for the 6N hand formula);
+#      run_meta may carry `hlo_cost` (the cost_summary: totals, roofline
+#      bound verdict, top cost centers) and `flops_per_token_matmul`
+#      (bench's analytic accounting, kept alongside for drift checks:
+#      scripts/perf_diff.py flags modeled-vs-measured MFU divergence),
+#      and trace records may carry `compute_spans` (per-layer FLOP-sized
+#      schematic spans from the ledger's loop attribution, rendered by
+#      trace_view next to the wire-sized collective spans) — all
+#      emitted only when the cost ledger ran, so older files stay
+#      byte-compatible with v11 readers
+SCHEMA_VERSION = 12
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -157,6 +172,9 @@ META_FIELDS: Dict[str, tuple] = {
     "schema_version": int,
     # trace record: the collective span template
     "spans": list,
+    # trace record: per-layer FLOP-sized compute spans from the HLO cost
+    # ledger's loop attribution (utils/hlo_cost; telemetry/trace.py)
+    "compute_spans": list,
     # flight record (telemetry/flight.py)
     "reason": str,
     "steps": list,
@@ -194,6 +212,14 @@ META_FIELDS: Dict[str, tuple] = {
     "grad_comm": dict,
     "comm_error": str,
     "aot": dict,
+    # HLO cost ledger summary (utils/hlo_cost.cost_summary): measured
+    # FLOPs/HBM totals, arithmetic intensity, and the named roofline
+    # bound verdict with top cost centers — the compute/HBM analogue of
+    # comm_measured
+    "hlo_cost": dict,
+    # bench's analytic matmul-FLOPs-per-token accounting, stamped next
+    # to the measured number so perf_diff can flag formula rot
+    "flops_per_token_matmul": _NUM,
     # autotuner diagnostics (autotuner/runtime_tuner.py): one per
     # timing decision / refused candidate, and bench's tune_e2e plan
     # summary — the stderr prints these replaced were invisible to
@@ -486,6 +512,21 @@ GAUGES: Dict[str, str] = {
                                "merged program — the grad slot's "
                                "overlap view (bucket releases inside "
                                "the backward scan)",
+    "hlo_flops": "compute FLOPs of the compiled step counted from its "
+                 "post-SPMD HLO (utils/hlo_cost.cost_ledger: dot/conv "
+                 "contracting-dim math, while bodies trip-multiplied) — "
+                 "the measured numerator the 6N hand formula "
+                 "approximates",
+    "hlo_hbm_bytes": "modeled HBM traffic of the compiled step "
+                     "(operand + result bytes per instruction, fusions "
+                     "priced at their call line, loop-multiplied)",
+    "step_mfu_hlo": "HLO-counted MFU: hlo_flops / median step wall / "
+                    "peak FLOPs per chip — per device, measured "
+                    "numerator and denominator",
+    "arithmetic_intensity": "hlo_flops / hlo_hbm_bytes (FLOPs per HBM "
+                            "byte); below the device's ridge intensity "
+                            "the program is HBM-bound "
+                            "(utils/hlo_cost.roofline_verdict)",
     "hpz_dcn_wire_bytes": "loop-resident (in-scan) all-gather wire "
                           "whose replica groups cross a DCN granule "
                           "(utils/hlo_comm.gather_link_split_in_loops) "

@@ -131,6 +131,56 @@ def collective_span_template(measured: Dict[str, object]) -> List[dict]:
     return spans
 
 
+def compute_span_template(loops: List[dict],
+                          total_flops: float) -> List[dict]:
+    """Schematic FLOP-sized compute span template from the HLO cost
+    ledger's loop attribution (utils/hlo_cost.cost_ledger `loops`): one
+    span per scan trip for short loops (the n_layer scans — this is the
+    per-layer attribution riding the scan structure), one aggregate span
+    for long loops, and one top-level span for the FLOPs outside every
+    loop (the head/loss matmuls).  Each span:
+
+      {"name", "flops", "loop_resident", "schematic": True}
+      (+ "body", "trips", "trip" on loop spans)
+
+    Widths in the timeline are proportional to `flops` — schematic, like
+    the wire-sized collective spans; the FLOP values are exact ledger
+    numbers."""
+    spans: List[dict] = []
+    loop_total = 0.0
+    for li, lp in enumerate(loops or []):
+        fl = float(lp.get("flops", 0.0))
+        if fl <= 0.0:
+            continue
+        loop_total += fl
+        trips = int(lp.get("trips", 1) or 1)
+        body = str(lp.get("body", f"loop{li}"))
+        if 1 < trips <= 64:
+            per = fl / trips
+            for t in range(trips):
+                spans.append({
+                    "name": f"scan{li} layer {t}",
+                    "body": body, "trips": trips, "trip": t,
+                    "flops": round(per, 3),
+                    "loop_resident": True, "schematic": True,
+                })
+        else:
+            spans.append({
+                "name": f"scan{li} x{trips}",
+                "body": body, "trips": trips,
+                "flops": round(fl, 3),
+                "loop_resident": True, "schematic": True,
+            })
+    top = float(total_flops) - loop_total
+    if top > 0.0:
+        spans.append({
+            "name": "top-level compute (head/loss)",
+            "flops": round(top, 3),
+            "loop_resident": False, "schematic": True,
+        })
+    return spans
+
+
 def load_run(path: str) -> Tuple[List[dict], List[dict], List[str]]:
     """(meta records, step records, parse errors) from a metrics JSONL —
     the report_run.py loader contract, shared here so trace_view.py and
@@ -182,6 +232,7 @@ def _json_safe(v):
 _TID_STEP = 0        # whole-step spans
 _TID_SEG = 1         # wall segments
 _TID_COMM = 2        # schematic collective spans
+_TID_FLOPS = 3       # schematic FLOP-sized compute spans (cost ledger)
 
 
 def chrome_trace(metas: List[dict], steps: List[dict],
@@ -192,16 +243,20 @@ def chrome_trace(metas: List[dict], steps: List[dict],
     each step's compute window (widths proportional to wire bytes,
     schematic).  Timestamps are microseconds from the first record."""
     spans = None
+    cspans = None
     tr = _find(metas, "trace")
     if tr is not None:
         spans = tr.get("spans")
+        cspans = tr.get("compute_spans")
+    run = _find(metas, "run_meta") or {}
     if spans is None:
-        run = _find(metas, "run_meta") or {}
         measured = run.get("comm_measured")
         if measured:
             spans = collective_span_template(measured)
     spans = spans or []
     total_wire = sum(s.get("wire_bytes", 0.0) for s in spans) or 1.0
+    cspans = cspans or []
+    total_flops = sum(s.get("flops", 0.0) for s in cspans) or 1.0
 
     events: List[dict] = [
         {"ph": "M", "pid": 0, "name": "process_name",
@@ -213,6 +268,11 @@ def chrome_trace(metas: List[dict], steps: List[dict],
         {"ph": "M", "pid": 0, "tid": _TID_COMM, "name": "thread_name",
          "args": {"name": "collectives (schematic, HLO ledger)"}},
     ]
+    if cspans:
+        events.append(
+            {"ph": "M", "pid": 0, "tid": _TID_FLOPS,
+             "name": "thread_name",
+             "args": {"name": "compute (schematic, HLO cost ledger)"}})
 
     timed = [r for r in steps if isinstance(r.get("ts"), (int, float))
              and isinstance(r.get("step_s"), (int, float))]
@@ -268,6 +328,22 @@ def chrome_trace(metas: List[dict], steps: List[dict],
                 ),
             })
             ccursor += sdur
+        # schematic compute sub-spans fill the same compute window
+        # proportionally by FLOPs (cost ledger per-layer attribution) —
+        # the per-layer compute next to the per-layer weight gathers
+        fcursor = c0
+        for sp in cspans:
+            fl = float(sp.get("flops", 0.0))
+            fdur = cdur * fl / total_flops
+            events.append({
+                "ph": "X", "pid": 0, "tid": _TID_FLOPS,
+                "name": sp.get("name", "compute"),
+                "ts": us(fcursor), "dur": us(fdur),
+                "args": _json_safe(
+                    {k: v for k, v in sp.items() if k != "name"}
+                ),
+            })
+            fcursor += fdur
 
     flight = _find(metas, "flight")
     if flight is not None:
@@ -284,8 +360,12 @@ def chrome_trace(metas: List[dict], steps: List[dict],
         "otherData": {
             "source": source,
             "schematic_collectives": bool(spans),
+            "schematic_compute": bool(cspans),
             "spans_total_wire_bytes": round(float(sum(
                 s.get("wire_bytes", 0.0) for s in spans
+            )), 3),
+            "spans_total_flops": round(float(sum(
+                s.get("flops", 0.0) for s in cspans
             )), 3),
         },
     }
