@@ -115,6 +115,12 @@ def _config_fingerprint(env=None) -> str:
         # lands in extra.sched.describe from the live engine)
         "sched_compose": env.get("BENCH_SCHED_COMPOSE", ""),
         "hpz": env.get("BENCH_HPZ", ""),
+        # wire-agenda arms: quantized ZeRO-3 tail, fp8 hpZ rebuild,
+        # and the DCN-aware "auto" sizing policy — absent keys read as
+        # defaults, so older cached rows stay replayable
+        "tail_quant": env.get("BENCH_TAIL_QUANT", ""),
+        "hpz_comm": env.get("BENCH_HPZ_COMM", ""),
+        "comm_auto": env.get("BENCH_COMM_AUTO", ""),
     }, sort_keys=True)
 
 
@@ -478,10 +484,29 @@ def _sched_extra(engine, compiled_step, hpz_gran=None):
         "gather_wire_bytes_in_loops": rep["gather_wire_bytes_in_loops"],
         "reduce_wire_bytes_in_loops": rep["reduce_wire_bytes_in_loops"],
     }
+    sched = engine._schedule
+    if sched.grad is not None and sched.grad.tail_mode != "fp32":
+        # quantized tail release: its sync is the once-per-step
+        # OUTSIDE-loop reduce wire (buckets are the in-loop wire)
+        out["tail_comm"] = sched.grad.tail_mode
+        out["zero3_tail_wire_bytes"] = round(
+            rep["reduce_wire_bytes_total"]
+            - rep["reduce_wire_bytes_in_loops"])
+    if sched.auto_plan is not None:
+        # the DCN-aware policy's resolved assignment + modeled bytes
+        out["auto_plan"] = sched.auto_plan
     if hpz_gran is not None:
         out["wire_bytes_by_link"] = wire_link_split(led, hpz_gran)
         out["in_scan_gather_link"] = gather_link_split_in_loops(
             led, hpz_gran)
+        if (sched.gather is not None and sched.gather.hpz
+                and sched.hpz_geom is not None):
+            from tiny_deepspeed_tpu.utils.hlo_comm import (
+                group_wire_outside_loops,
+            )
+            out["hpz_comm"] = sched.gather.hpz_mode
+            out["hpz_rebuild_dcn_bytes"] = round(
+                group_wire_outside_loops(led, sched.hpz_geom[1]))
     return {"sched": out}
 
 
@@ -597,6 +622,20 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
     sched_compose = os.environ.get("BENCH_SCHED_COMPOSE")
     bench_hpz = os.environ.get("BENCH_HPZ")
     hpz_gran = None
+    if os.environ.get("BENCH_COMM_AUTO"):
+        # wire-agenda arm: DCN-aware "auto" sizing — the engine resolves
+        # codec / bucket count / inner-group factor from the mesh's
+        # granule map (parallel/schedule.auto_comm_plan); the record's
+        # extra.sched carries the resolved plan for the A/B against the
+        # hand-set arms
+        ek["grad_comm"] = "auto"
+        ek["grad_buckets"] = "auto"
+        ek["gather_groups"] = "auto"
+    if os.environ.get("BENCH_TAIL_QUANT"):
+        # wire-agenda arm: quantized ZeRO-3 tail release — rides the
+        # grad codec (defaults int8 when no explicit BENCH_GRAD_COMM)
+        ek["grad_comm"] = os.environ.get("BENCH_GRAD_COMM") or "int8"
+        ek["grad_comm_tail"] = os.environ["BENCH_TAIL_QUANT"]
     if sched_compose:
         # round-9 A/B: the scheduler-composed FULL STACK (ZeRO-3 +
         # gather prefetch + bucketed quantized grads + per-layer
@@ -631,7 +670,13 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             )
         ek["hpz"] = True
         ek["hpz_granule_of"] = hpz_gran
-    if gather_prefetch or sched_compose or bench_hpz:
+        if os.environ.get("BENCH_HPZ_COMM"):
+            # wire-agenda arm: qwZ — the secondary rebuild's
+            # inter-granule all_gather moves fp8 blocks + scales
+            ek["hpz_comm"] = os.environ["BENCH_HPZ_COMM"]
+    if (gather_prefetch or sched_compose or bench_hpz
+            or os.environ.get("BENCH_TAIL_QUANT")
+            or os.environ.get("BENCH_COMM_AUTO")):
         from tiny_deepspeed_tpu import Zero3
         engine = Zero3(model, opt, mesh=mesh, **ek)
         b *= n_chips
@@ -842,7 +887,9 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
                                       gather_prefetch, gather_quant)
                if gather_prefetch else {}),
             **(_sched_extra(engine, compiled_step, hpz_gran)
-               if (sched_compose or bench_hpz) else {}),
+               if (sched_compose or bench_hpz
+                   or os.environ.get("BENCH_TAIL_QUANT")
+                   or os.environ.get("BENCH_COMM_AUTO")) else {}),
             "effective": {
                 "remat": str(cfg.remat),
                 "fused_xent": str(cfg.fused_xent),
@@ -1469,8 +1516,73 @@ def run_tune_e2e(model_name: str):
     serve_plan, serve_tok, serve_trials = tune_e2e(
         measure_serve, serve_space, objective="max")
 
+    # -- comm objective: measured step time + measured ledger wire ---------
+    # The wire-agenda phase (multi-chip only — a single chip runs no
+    # gradient collective): coordinate descent over the comm knob space
+    # {codec, bucket count, tail codec, hpz on/off + codec, "auto"},
+    # each trial scored by MEASURED step seconds plus the compiled
+    # step's MEASURED loop-resident wire priced at an assumed 100 GB/s
+    # — the wire term breaks step-time ties toward the plan that also
+    # moves fewer bytes (on the CPU mesh step time barely sees wire;
+    # on a real pod both terms pull the same way).  Infeasible combos
+    # (tail codec without a quantized grad slot) raise inside the
+    # engine and score worst — tune_e2e's standard failure handling.
+    comm_plan, comm_trials = {}, []
+    comm_s = None
+    n_chips = len(jax.devices())
+    if n_chips > 1:
+        from tiny_deepspeed_tpu import Zero3, make_mesh
+        from tiny_deepspeed_tpu.parallel.mesh import granule_map
+        from tiny_deepspeed_tpu.parallel.schedule import (
+            comm_plan_engine_kwargs,
+        )
+        from tiny_deepspeed_tpu.utils.hlo_comm import (
+            collective_ledger, overlap_report,
+        )
+        cmesh = make_mesh()
+        hgran = granule_map(cmesh.devices.flatten())
+        if hgran is None and n_chips % 2 == 0:
+            # the emulated 2-slice split the wire_link_split tests pin
+            hgran = {i: i // (n_chips // 2) for i in range(n_chips)}
+        nl = int(base.n_layer)
+        comm_space = {
+            "grad_comm": ["auto", "int8", "fp8", "fp32"],
+            "grad_buckets": [1] + [k for k in (2, 4)
+                                   if nl % k == 0 and k <= nl],
+            "grad_comm_tail": ["fp32", "int8"],
+        }
+        if hgran is not None:
+            comm_space["hpz"] = [False, True]
+            comm_space["hpz_comm"] = ["fp32", "fp8"]
+        wire_bw = 100e9  # assumed link GB/s for the tie-break term
+
+        def measure_comm(plan):
+            kw = comm_plan_engine_kwargs(plan)
+            if not kw.get("hpz"):
+                kw.pop("hpz_comm", None)
+            elif hgran is not None:
+                kw["hpz_granule_of"] = hgran
+            eng = Zero3(build_model(base), AdamW(lr=1e-4), mesh=cmesh,
+                        **kw)
+            state = eng.init(jax.random.PRNGKey(0))
+            idx = jax.random.randint(jax.random.PRNGKey(1),
+                                     (b * n_chips, t), 0,
+                                     base.vocab_size, jnp.int32)
+            step_s, _ = measure(eng, state, (idx, idx), warmup=2,
+                                iters=iters)
+            rep = overlap_report(
+                eng._step.lower(state, (idx, idx)).compile().as_text())
+            wire = (rep["reduce_wire_bytes_total"]
+                    + rep["gather_wire_bytes_total"])
+            return step_s + wire / wire_bw
+
+        comm_plan, comm_s, comm_trials = tune_e2e(
+            measure_comm, comm_space, objective="min")
+        if not comm_plan.get("hpz"):
+            comm_plan.pop("hpz_comm", None)
+
     # -- persist + record --------------------------------------------------
-    plan = {**train_plan, **serve_plan}
+    plan = {**train_plan, **serve_plan, **comm_plan}
     mesh, backend = _mesh_desc()
     key = plan_key(model_name, mesh, backend)
     record = {
@@ -1482,6 +1594,13 @@ def run_tune_e2e(model_name: str):
         "serve_trials": len(serve_trials),
         "batch": b, "seq": t, "backend": backend, "mesh": mesh,
     }
+    if comm_trials:
+        record.update(
+            comm_score_default=comm_trials[0]["score"],
+            comm_score_tuned=comm_s,
+            comm_trials=len(comm_trials),
+            comm_plan={k: comm_plan[k] for k in sorted(comm_plan)},
+        )
     path = _tune_cache_path()
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tuner = RuntimeAutoTuner()
@@ -1490,7 +1609,10 @@ def run_tune_e2e(model_name: str):
             tuner.load(path)  # other configs' winners/plans survive
         except (OSError, ValueError):
             pass
-    tuner.store_plan(key, plan, record)
+    # merge: a partial re-tune (e.g. a comm-only sweep on a new mesh
+    # window) folds into the stored plan instead of dropping the other
+    # phases' winners
+    tuner.store_plan(key, plan, record, merge=True)
     tuner.save(path)
     # the produced plan governs THIS record's fingerprint too
     os.environ["BENCH_TUNE_PLAN"] = plan_hash(plan)
@@ -1511,6 +1633,7 @@ def run_tune_e2e(model_name: str):
                             "plan_hash": plan_hash(plan), "record": record,
                             "train_trials": train_trials,
                             "serve_trials": serve_trials,
+                            "comm_trials": comm_trials,
                         })
     except OSError:
         pass

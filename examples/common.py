@@ -212,7 +212,13 @@ def parse_args(default_model="gpt2-124m", **defaults):
              "hpz': each element declares one scheduler slot; 'health' "
              "upgrades --telemetry to layers, 'hpz' holds a secondary "
              "compute-dtype weight replica per slice so ZeRO-3's "
-             "in-scan gathers never cross DCN (ZeRO++).  Legacy flags "
+             "in-scan gathers never cross DCN (ZeRO++).  Wire-agenda "
+             "keys: 'grad_comm_tail=int8' quantizes the ZeRO-3 "
+             "non-block tail release, 'hpz_comm=fp8' moves the hpZ "
+             "secondary rebuild as fp8 blocks + scales (qwZ), and "
+             "'grad_comm=auto'/'grad_buckets=auto'/'gather_groups="
+             "auto' size the codec/K/m from the mesh's granule map "
+             "(schedule.auto_comm_plan).  Legacy flags "
              "(--grad-comm/--grad-buckets/--gather-prefetch/...) keep "
              "working and merge with this spec; --sched wins on "
              "conflict",

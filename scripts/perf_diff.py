@@ -56,6 +56,34 @@ _FINGERPRINT_KEYS = ("chips", "seq_len")
 # bench vocabulary (tokens/s, requests/s, speedup) is higher-is-better
 _LOWER_IS_BETTER = ("time", "latency", "_ms", "_s_", "ttft")
 
+# per-fingerprint ledger wire-byte fields (extra.sched, stamped by the
+# scheduler bench arms): dotted path -> short label.  Wire bytes are
+# measured from the compiled HLO and deterministic per program, so a
+# newest-vs-best increase beyond the noise floor is a COMM regression —
+# the program started moving more bytes — even when the clock (step
+# time on a CPU mesh) never noticed
+_WIRE_KEYS = (
+    ("sched.gather_wire_bytes_in_loops", "loop gather wire"),
+    ("sched.reduce_wire_bytes_in_loops", "loop reduce wire"),
+    ("sched.zero3_tail_wire_bytes", "zero3 tail wire"),
+    ("sched.hpz_rebuild_dcn_bytes", "hpz rebuild DCN wire"),
+    ("sched.wire_bytes_by_link.ici_wire_bytes", "ICI wire"),
+    ("sched.wire_bytes_by_link.dcn_wire_bytes", "DCN wire"),
+)
+
+
+def _wire_of(rec: dict, dotted: str) -> Optional[float]:
+    """Numeric field at a dotted path under extra, or None."""
+    node = rec.get("extra") or {}
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    try:
+        return float(node)
+    except (TypeError, ValueError):
+        return None
+
 
 def _records_of(obj) -> List[dict]:
     """Bench records inside one loaded JSON value: a driver wrapper
@@ -212,6 +240,28 @@ def diff_rounds(rounds: List[Tuple[str, List[dict]]],
                 f"({-delta:+.1%} > noise {threshold:.1%} = "
                 f"max(floor {noise_floor:.1%}, spread {spread:.1%}))"
             )
+        # comm regression: per-fingerprint ledger wire bytes — newest vs
+        # the best (lowest) prior value carrying the same field.  Both
+        # sides must stamp the field: a round that predates the
+        # scheduler arms (no extra.sched) simply does not participate,
+        # so the committed trajectory stays comparable
+        for dotted, label in _WIRE_KEYS:
+            w_new = _wire_of(newest, dotted)
+            w_prior = [w for w in (_wire_of(r, dotted) for _, r in prior)
+                       if w is not None]
+            if w_new is None or not w_prior:
+                continue
+            best_w = min(w_prior)
+            if best_w <= 0.0:
+                continue
+            rel = (w_new - best_w) / best_w
+            if rel > noise_floor:
+                regressions.append(
+                    f"REGRESSION {fp} [{newest_name}]: {label} "
+                    f"{w_new:,.0f} B vs best-of-{len(w_prior)} "
+                    f"{best_w:,.0f} B ({rel:+.1%} > {noise_floor:.1%}) "
+                    f"— the compiled step moves more bytes"
+                )
         # program growth: HLO-counted FLOPs for the same fingerprint
         f_old = _sidecar_flops(prior[-1][1],
                                os.path.dirname(prior[-1][0]) or ".")

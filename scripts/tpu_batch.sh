@@ -192,4 +192,22 @@ log "   hpz rc=$? $(cat "$OUT/bench_hpz.json" 2>/dev/null | head -c 200)"
 timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_HPZ=1 BENCH_SCHED_COMPOSE=1 python bench.py > "$OUT/bench_hpz_compose.json" 2> "$OUT/bench_hpz_compose.err"
 log "   hpz+compose rc=$? $(cat "$OUT/bench_hpz_compose.json" 2>/dev/null | head -c 200)"
 
+log "20. wire-agenda close-out (round-17: quantized ZeRO-3 tail release,"
+log "    qwZ fp8 hpZ rebuild, DCN-aware 'auto' sizing, and the tune_e2e"
+log "    comm phase.  A/B against the step-19 rows: the tail arm's"
+log "    extra.sched.zero3_tail_wire_bytes vs the fp32 transpose's, the"
+log "    hpz_comm=fp8 arm's hpz_rebuild_dcn_bytes vs the step-19 hpz"
+log "    row's (~4x), and the auto arm's resolved plan + measured"
+log "    per-link wire vs the best hand-set row.  The re-run tune_e2e"
+log "    row now also walks the comm space (multi-chip) and persists"
+log "    the comm plan into artifacts/autotune_cache.json)"
+timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_SCHED_COMPOSE=1 BENCH_TAIL_QUANT=int8 python bench.py > "$OUT/bench_tail_quant.json" 2> "$OUT/bench_tail_quant.err"
+log "   tail int8 rc=$? $(cat "$OUT/bench_tail_quant.json" 2>/dev/null | head -c 200)"
+timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_HPZ=1 BENCH_HPZ_COMM=fp8 BENCH_GATHER_PREFETCH=2 python bench.py > "$OUT/bench_hpz_fp8.json" 2> "$OUT/bench_hpz_fp8.err"
+log "   hpz fp8 rebuild rc=$? $(cat "$OUT/bench_hpz_fp8.json" 2>/dev/null | head -c 200)"
+timeout 2400 env BENCH_MODEL=gpt2-1.5b BENCH_COMM_AUTO=1 python bench.py > "$OUT/bench_comm_auto.json" 2> "$OUT/bench_comm_auto.err"
+log "   comm auto rc=$? $(cat "$OUT/bench_comm_auto.json" 2>/dev/null | head -c 200)"
+timeout 3000 env BENCH_TUNE_E2E=1 python bench.py > "$OUT/bench_tune_e2e_comm.json" 2> "$OUT/bench_tune_e2e_comm.err"
+log "   tune_e2e (comm phase) rc=$? $(cat "$OUT/bench_tune_e2e_comm.json" 2>/dev/null | head -c 240)"
+
 log "batch complete; results in $OUT"

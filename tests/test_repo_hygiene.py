@@ -517,6 +517,45 @@ def test_hlo_cost_schema_v12_names():
         )
 
 
+def test_wire_agenda_schema_v13_names():
+    """Schema-v13 drift guard (the wire-agenda close-out): the quantized
+    tail / hpZ rebuild gauges must stay documented AND registered by
+    telemetry/registry.capture_compiled, utils/hlo_comm.py must keep
+    the exact-group isolation helper the rebuild pin reads, and the
+    scheduler must keep the "auto" sizing + plan round-trip entry
+    points bench and the tuner consume."""
+    from tiny_deepspeed_tpu.telemetry import schema
+
+    assert schema.SCHEMA_VERSION >= 13
+    v13_gauges = {"zero3_tail_wire_bytes", "hpz_rebuild_dcn_bytes"}
+    assert v13_gauges <= set(schema.GAUGES), (
+        v13_gauges - set(schema.GAUGES))
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "telemetry", "registry.py")) as f:
+        reg_src = f.read()
+    for g in sorted(v13_gauges):
+        assert f'"{g}"' in reg_src, (
+            f"gauge {g} documented in schema but no longer registered "
+            "by telemetry/registry.py capture_compiled"
+        )
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "utils", "hlo_comm.py")) as f:
+        hlo_src = f.read()
+    assert "group_wire_outside_loops" in hlo_src, (
+        "group_wire_outside_loops gone from utils/hlo_comm.py — the "
+        "hpZ rebuild pin and the registry gauge read it"
+    )
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "parallel", "schedule.py")) as f:
+        sched_src = f.read()
+    for name in ("auto_comm_plan", "comm_plan_engine_kwargs",
+                 "COMM_PLAN_KEYS"):
+        assert name in sched_src, (
+            f"{name} gone from parallel/schedule.py — bench's comm "
+            "phase and the AOT plan round-trip consume it"
+        )
+
+
 def test_perf_diff_check_committed_trajectory():
     """CI wiring for the perf regression sentinel: `perf_diff --check`
     must run green against the committed BENCH_*.json trajectory.  A

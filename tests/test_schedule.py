@@ -507,3 +507,291 @@ class TestHpz:
         without = in_scan(Zero3(model, AdamW(lr=1e-3), **kw))
         assert with_hpz["dcn_wire_bytes"] < without["dcn_wire_bytes"]
         assert with_hpz["ici_wire_bytes"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# wire agenda (ISSUE 17): quantized tail + fp8 hpZ rebuild + "auto" sizing
+# ---------------------------------------------------------------------------
+
+class TestWireKnobValidation:
+    """Quick tier: the loud refusals and spec vocabulary of the new
+    codec knobs (no compiles — build_schedule / parse only)."""
+
+    def test_tail_needs_stage3(self, model):
+        with pytest.raises(ValueError, match="ZeRO-3 knob"):
+            _build(model, grad_comm="int8", grad_comm_tail="int8")
+
+    def test_tail_needs_quantized_grad_slot(self, model):
+        with pytest.raises(ValueError, match="quantized grad slot"):
+            _build(model, stage=3, gather_prefetch=2,
+                   grad_comm_tail="int8")
+
+    def test_hpz_comm_needs_hpz(self, model):
+        with pytest.raises(ValueError, match="hpz=True"):
+            _build(model, stage=3, hpz_comm="fp8",
+                   granule_of=GRAN2)
+
+    def test_bad_modes_refused(self, model):
+        with pytest.raises(ValueError, match="grad_comm_tail"):
+            _build(model, stage=3, grad_comm="int8",
+                   grad_comm_tail="int4")
+        with pytest.raises(ValueError, match="hpz_comm"):
+            _build(model, stage=3, hpz=True, granule_of=GRAN2,
+                   hpz_comm="int4")
+
+    def test_describe_names_the_codecs(self, model):
+        sched = _build(model, stage=3, grad_comm="int8",
+                       grad_comm_tail="int8")
+        assert "tail_comm=int8" in sched.describe()
+        sched = _build(model, stage=3, hpz=True, granule_of=GRAN2,
+                       gather_prefetch=2, hpz_comm="fp8")
+        assert "hpz[fp8]" in sched.describe()
+
+    def test_sched_spec_vocabulary(self):
+        out = S.parse_sched_spec(
+            "grad_comm=auto,grad_buckets=auto,gather_groups=auto,"
+            "grad_comm_tail=int8,hpz,hpz_comm=fp8")
+        assert out == {
+            "grad_comm": "auto", "grad_buckets": "auto",
+            "gather_groups": "auto", "grad_comm_tail": "int8",
+            "hpz": True, "hpz_comm": "fp8",
+        }
+        with pytest.raises(ValueError, match="grad_comm_tail"):
+            S.parse_sched_spec("grad_comm_tail=auto")
+
+
+class TestAutoSizing:
+    """Quick tier: auto_comm_plan is a pure function of static geometry
+    — the DCN-aware sizing rules, unit-tested without a mesh — plus the
+    build_schedule / engine resolution seam ("auto" never survives into
+    a slot or a describe string)."""
+
+    def test_granule_geometry(self):
+        from tiny_deepspeed_tpu.parallel.mesh import granule_geometry
+        assert granule_geometry(None, 8) == (1, 8)
+        assert granule_geometry({}, 8) == (1, 8)
+        assert granule_geometry(GRAN2, 8) == (2, 4)
+        # a map whose granules do not divide n gets no 2-hop sizing
+        assert granule_geometry({i: i % 3 for i in range(8)}, 8) == (3, 8)
+        # degenerate single-granule map is the flat mesh
+        assert granule_geometry({i: 0 for i in range(8)}, 8) == (1, 8)
+
+    def test_single_rank_is_fp32(self):
+        plan = S.auto_comm_plan(n_shard=1, n_layer=2)
+        assert plan["grad_comm"] == "fp32"
+        assert plan["grad_buckets"] == 1
+        assert plan["gather_inner"] is None
+
+    def test_flat_mesh_plan(self, model):
+        plan = S.auto_comm_plan(n_shard=8, n_layer=TINY.n_layer,
+                                shapes=model.param_shapes())
+        assert plan["grad_comm"] == "int8"
+        assert plan["gather_inner"] is None  # flat: 2-hop moves bytes twice
+        assert TINY.n_layer % plan["grad_buckets"] == 0
+        m = plan["modeled"]
+        assert m["grad_wire_bytes"] <= 1.1 * m["grad_wire_bytes_monolithic"]
+        assert m["fp32_allreduce_wire_bytes"] > m["grad_wire_bytes"]
+        assert m["dcn_frac_est"] == 0.0
+
+    def test_hybrid_mesh_plan(self, model):
+        plan = S.auto_comm_plan(n_shard=8, n_layer=TINY.n_layer,
+                                shapes=model.param_shapes(),
+                                granule_of=GRAN2)
+        assert plan["n_granules"] == 2
+        assert plan["gather_inner"] == 4  # ici: fat first hop stays on-slice
+        # hybrid cap: every bucket sync crosses DCN, so the divisor
+        # search is capped at max(2, max_buckets // n_granules)
+        assert plan["grad_buckets"] <= max(2, 8 // 2)
+        assert plan["modeled"]["dcn_frac_est"] == 1.0
+
+    def test_bucket_divisor_rule(self, model):
+        # n_layer=2: only k in {1, 2} are admissible; whatever wins must
+        # keep the modeled wire within the padding tolerance
+        plan = S.auto_comm_plan(n_shard=8, n_layer=2,
+                                shapes=model.param_shapes(),
+                                max_buckets=8)
+        assert plan["grad_buckets"] in (1, 2)
+        # no shapes -> no byte model -> conservative 1 bucket
+        plan = S.auto_comm_plan(n_shard=8, n_layer=2)
+        assert plan["grad_buckets"] == 1 and "modeled" not in plan
+
+    def test_build_resolves_auto(self, model):
+        sched = _build(model, stage=3, grad_comm="auto",
+                       grad_buckets="auto")
+        assert sched.grad is not None and sched.grad.mode == "int8"
+        assert sched.grad.buckets >= 1
+        assert sched.auto_plan is not None
+        assert "auto" not in sched.describe()
+
+    def test_auto_buckets_under_explicit_fp32(self, model):
+        # a plain fp32 all-reduce program has no bucket machinery to
+        # size: auto buckets resolve to 1 and no grad slot is declared
+        sched = _build(model, grad_comm="fp32", grad_buckets="auto")
+        assert sched.grad is None and sched.lowering == "plain"
+
+    def test_auto_groups_only_on_legacy_prefetch(self, model):
+        # single-slot prefetch on the hybrid mesh: auto -> inner=ici
+        sched = _build(model, stage=3, gather_prefetch=2,
+                       gather_groups="auto", granule_of=GRAN2)
+        assert sched.lowering == "prefetch"
+        assert sched.gather.groups == 4
+        # any composition: the composed machine refuses 2-hop groups,
+        # so auto resolves to flat instead of a ScheduleConflictError
+        sched = _build(model, stage=3, gather_prefetch=2,
+                       gather_groups="auto", grad_comm="int8",
+                       granule_of=GRAN2)
+        assert sched.lowering == "composed"
+        assert sched.gather.groups is None
+
+    def test_engine_auto_resolution(self, model):
+        eng = Zero3(model, AdamW(lr=1e-3), grad_comm="auto",
+                    grad_buckets="auto", gather_prefetch=2)
+        # the engine reads the RESOLVED knobs back off the schedule —
+        # telemetry/bench fingerprints never see the literal "auto"
+        assert eng.grad_comm == "int8"
+        assert isinstance(eng.grad_buckets, int)
+        assert "auto" not in eng.describe()
+        assert eng._schedule.auto_plan["grad_comm"] == "int8"
+
+
+class TestCommPlanRoundTrip:
+    """Quick tier: the AOT-cache seam — a tune_e2e comm plan merged into
+    the store survives save/load and feeds straight back into an engine
+    via comm_plan_engine_kwargs (the acceptance round-trip)."""
+
+    def test_store_merge_save_load_build(self, model, tmp_path):
+        from tiny_deepspeed_tpu.autotuner import (
+            RuntimeAutoTuner, plan_key,
+        )
+        t = RuntimeAutoTuner(warmup=1, iters=1)
+        key = plan_key("tiny", "cpu8", "cpu")
+        # phase 1 (train knobs), then the comm phase folds in on top
+        t.store_plan(key, {"micro_batch": 8}, {"phase": "train"})
+        h = t.store_plan(
+            key,
+            {"grad_comm": "int8", "grad_buckets": 2,
+             "grad_comm_tail": "int8", "gather_prefetch": 2},
+            {"comm_score_tuned": 1.0}, merge=True)
+        assert h
+        path = str(tmp_path / "plans.json")
+        t.save(path)
+        t2 = RuntimeAutoTuner(warmup=1, iters=1)
+        t2.load(path)
+        entry = t2.get_plan(key)
+        assert entry["plan"]["micro_batch"] == 8  # merge kept phase 1
+        assert entry["record"]["phase"] == "train"
+        assert entry["record"]["comm_score_tuned"] == 1.0
+        kw = S.comm_plan_engine_kwargs(entry["plan"])
+        assert kw == {"grad_comm": "int8", "grad_buckets": 2,
+                      "grad_comm_tail": "int8", "gather_prefetch": 2}
+        eng = Zero3(model, AdamW(lr=1e-3), **kw)
+        assert eng._lowering == "composed"
+        assert "tail_comm=int8" in eng._schedule.describe()
+
+    def test_plan_keys_cover_the_comm_space(self):
+        # ONE list shared by bench's comm phase and this round-trip:
+        # every knob the tuner may persist is an engine kwarg
+        assert set(S.COMM_PLAN_KEYS) == {
+            "grad_comm", "grad_buckets", "grad_comm_tail",
+            "gather_groups", "gather_prefetch", "hpz", "hpz_comm",
+        }
+        assert S.comm_plan_engine_kwargs(
+            {"grad_comm": "int8", "gather_groups": None, "junk": 3}
+        ) == {"grad_comm": "int8"}
+
+
+@pytest.mark.slow
+class TestTailQuant:
+    """Acceptance (wire agenda): the composed ZeRO-3 non-block tail
+    releases through the PR-7 blockwise codec with its own residual
+    slice — total loop+tail grad wire >= 3x lower than fp32, 20-step
+    parity < 5%, and the fp32 path stays HLO-identical when off."""
+
+    def test_off_path_hlo_identical(self, model):
+        def hlo(**kw):
+            eng = Zero3(model, AdamW(lr=1e-3), gather_prefetch=2,
+                        grad_buckets=2, grad_comm="int8", **kw)
+            state = eng.init(jax.random.PRNGKey(0))
+            return eng._step.lower(state, make_batch()).as_text()
+        assert hlo() == hlo(grad_comm_tail="fp32")
+
+    def test_tail_parity_residual_and_wire_pin(self, model):
+        from tiny_deepspeed_tpu.utils.hlo_comm import (
+            collective_ledger, overlap_report,
+        )
+        base, _ = run_curve(Zero3(model, AdamW(lr=1e-3)))
+
+        def measure(**kw):
+            eng = Zero3(model, AdamW(lr=1e-3), gather_prefetch=2,
+                        grad_buckets=2, **kw)
+            assert eng._lowering == "composed"
+            comp, state = run_curve(eng)
+            txt = eng._step.lower(state, make_batch()).compile().as_text()
+            rep = overlap_report(txt, led=collective_ledger(txt))
+            return comp, state, eng, rep["reduce_wire_bytes_total"]
+
+        comp32, _, _, w32 = measure()
+        compq, state, eng, wq = measure(grad_comm="int8",
+                                        grad_comm_tail="int8")
+        assert max(abs(a - b) for a, b in zip(base, comp32)) < 1e-4
+        assert abs(compq[-1] - base[-1]) / abs(base[-1]) < 0.05
+        # acceptance: composed ZeRO-3 grad wire INCLUDING the tail
+        # (total reduce wire: in-scan bucket syncs + the once-per-step
+        # tail release outside the scans) >= 3x lower quantized
+        assert w32 / wq >= 3.0
+        # the residual grew a tail slice (vs the no-tail pin in
+        # test_int8_compose_parity_and_residual)
+        lay = eng._schedule.layout
+        assert state.grad_residual.shape == (
+            8, 2 * lay["bucket_pad"] + lay["tail_pad"])
+
+    def test_tail_gauge_via_capture_compiled(self, model):
+        telem = Telemetry()
+        eng = Zero3(model, AdamW(lr=1e-3), gather_prefetch=2,
+                    grad_buckets=2, grad_comm="int8",
+                    grad_comm_tail="int8", telemetry=telem)
+        state = eng.init(jax.random.PRNGKey(0))
+        telem.capture_compiled(state, make_batch())
+        assert telem.gauges["zero3_tail_wire_bytes"] > 0.0
+
+
+@pytest.mark.slow
+class TestHpzQuant:
+    """Acceptance (qwZ, ZeRO++ arXiv:2306.10209): the hpZ secondary
+    rebuild's inter-slice all_gather moves fp8 blocks + scales — its
+    DCN wire >= 3x lower than fp32, loss parity < 5%."""
+
+    def _rebuild_wire(self, model, **kw):
+        from tiny_deepspeed_tpu.utils.hlo_comm import (
+            collective_ledger, group_wire_outside_loops,
+        )
+        eng = Zero3(model, AdamW(lr=1e-3), hpz=True,
+                    hpz_granule_of=GRAN2, gather_prefetch=2, **kw)
+        comp, state = run_curve(eng)
+        txt = eng._step.lower(state, make_batch()).compile().as_text()
+        # the rebuild hop ISOLATED: outside-loop wire on exactly the
+        # inter-granule replica groups (the tail gathers share the DCN
+        # link but run over different groups)
+        inter = eng._schedule.hpz_geom[1]
+        return comp, group_wire_outside_loops(collective_ledger(txt),
+                                              inter)
+
+    def test_fp8_rebuild_dcn_pin_and_parity(self, model):
+        base, _ = run_curve(Zero3(model, AdamW(lr=1e-3)))
+        c32, w32 = self._rebuild_wire(model)
+        c8, w8 = self._rebuild_wire(model, hpz_comm="fp8")
+        assert max(abs(a - b) for a, b in zip(base, c32)) < 1e-4
+        assert abs(c8[-1] - base[-1]) / abs(base[-1]) < 0.05
+        assert w32 > 0.0 and w8 > 0.0
+        # fp8 blocks + f32 scale rows vs f32 shards: ~4x, pinned >= 3x
+        assert w32 / w8 >= 3.0
+
+    def test_rebuild_gauge_via_capture_compiled(self, model):
+        telem = Telemetry()
+        eng = Zero3(model, AdamW(lr=1e-3), hpz=True,
+                    hpz_granule_of=GRAN2, hpz_comm="fp8",
+                    telemetry=telem)
+        state = eng.init(jax.random.PRNGKey(0))
+        telem.capture_compiled(state, make_batch(), granule_of=GRAN2)
+        assert telem.gauges["hpz_rebuild_dcn_bytes"] > 0.0
+        assert telem.gauges["hpz_dcn_wire_bytes"] == 0.0

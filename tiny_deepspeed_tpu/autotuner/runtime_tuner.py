@@ -297,12 +297,21 @@ class RuntimeAutoTuner:
     # same AOT cache file, keyed per (model, mesh, backend).
 
     def store_plan(self, key: str, plan: Dict, record: Optional[Dict]
-                   = None) -> str:
+                   = None, merge: bool = False) -> str:
         """Remember `plan` for `key` (use plan_key()); `record` carries
-        the measured A/B evidence.  Returns the plan hash."""
+        the measured A/B evidence.  Returns the plan hash.
+
+        merge=True folds `plan` (and `record`) into an existing entry
+        for the key instead of replacing it — how the bench's phased
+        tune_e2e (train knobs, then serve knobs, then the comm space)
+        accretes ONE plan per workload across phases; the hash is
+        recomputed over the merged assignment."""
         plans = getattr(self, "_plans", None)
         if plans is None:
             plans = self._plans = {}
+        if merge and key in plans:
+            plan = {**plans[key].get("plan", {}), **plan}
+            record = {**plans[key].get("record", {}), **(record or {})}
         plans[key] = {"plan": dict(plan), "hash": plan_hash(plan),
                       "record": dict(record or {})}
         return plans[key]["hash"]

@@ -147,6 +147,24 @@ def _dequant_rows(q, s):
     ).reshape(k, r)
 
 
+def as_wire(q):
+    """Bitcast an fp8 payload to u8 for the collective: backends without
+    native f8 collectives (XLA:CPU here) otherwise CONVERT the operand
+    to f16 — doubling the one wire the codec exists to shrink.  u8 moves
+    1 byte/elem everywhere; int8 payloads pass through untouched (their
+    collectives are already native), keeping the int8 HLO byte-identical."""
+    if q.dtype == jnp.float8_e4m3fn:
+        return jax.lax.bitcast_convert_type(q, jnp.uint8)
+    return q
+
+
+def from_wire(q, mode: str):
+    """Undo `as_wire` after the collective."""
+    if mode == "fp8" and q.dtype == jnp.uint8:
+        return jax.lax.bitcast_convert_type(q, jnp.float8_e4m3fn)
+    return q
+
+
 # ---------------------------------------------------------------------------
 # the schedule (inside a shard_map manual region over `axis`)
 # ---------------------------------------------------------------------------
@@ -208,17 +226,19 @@ def quantized_reduce_scatter(flat, axis: str, n: int, mode: str, *,
         pre_q = quantize_blockwise(flat, mode, block, rng)
     q, s = pre_q
     if not inner or inner in (1, n):
-        parts = q.reshape(n, e // n)
+        parts = as_wire(q).reshape(n, e // n)
         srows = s.reshape(n, -1)
         parts = jax.lax.all_to_all(parts, axis, 0, 0, tiled=True)
         srows = jax.lax.all_to_all(srows, axis, 0, 0, tiled=True)
-        return jnp.sum(_dequant_rows(parts, srows), axis=0)
+        return jnp.sum(_dequant_rows(from_wire(parts, mode), srows),
+                       axis=0)
     intra, inter = _hier_groups(n, inner)
     # hop 1: low-precision reduce-scatter within the inner group
-    parts = q.reshape(inner, e // inner)
+    parts = as_wire(q).reshape(inner, e // inner)
     srows = s.reshape(inner, -1)
     parts = jax.lax.all_to_all(parts, axis, 0, 0,
                                axis_index_groups=intra, tiled=True)
+    parts = from_wire(parts, mode)
     srows = jax.lax.all_to_all(srows, axis, 0, 0,
                                axis_index_groups=intra, tiled=True)
     part = jnp.sum(_dequant_rows(parts, srows), axis=0)   # (E/inner,)
@@ -238,9 +258,9 @@ def quantized_all_gather(chunk, axis: str, n: int, mode: str, *,
     order; the hierarchical schedule leaves pieces rank-permuted, so they
     are re-ordered by the static `piece_owner` table."""
     q, s = quantize_blockwise(chunk, mode, block, rng)
-    rows = jax.lax.all_gather(q, axis, axis=0, tiled=False)
+    rows = jax.lax.all_gather(as_wire(q), axis, axis=0, tiled=False)
     srows = jax.lax.all_gather(s.reshape(-1), axis, axis=0, tiled=False)
-    vals = _dequant_rows(rows, srows)                     # (n, E/n)
+    vals = _dequant_rows(from_wire(rows, mode), srows)    # (n, E/n)
     owner = piece_owner(n, inner)
     if not np.array_equal(owner, np.arange(n)):
         vals = vals[owner]
@@ -415,3 +435,23 @@ def modeled_wire_bytes(n_elems: int, n: int, mode: str, *,
         "fp32_allreduce_wire_bytes": float(2 * 4 * n_elems * (n - 1) / n)
         if n > 1 else 0.0,
     }
+
+
+def modeled_hpz_rebuild_bytes(shard_bytes: int, shard_elems: int,
+                              n_gran: int, mode: str, *,
+                              block: int = DEFAULT_BLOCK) -> float:
+    """Ring-model per-device wire of the once-per-step hpZ secondary
+    rebuild: each rank's global 1/n shard of the sharded stacked leaves
+    all-gathers over the `n_gran` inter-slice group (parallel/schedule
+    build_sec).  Passthrough mode gathers the leaves at their stacked
+    dtype (`shard_bytes`); a quantized mode (qwZ-style, ZeRO++
+    arXiv:2306.10209) gathers ONE concatenated blockwise-quantized
+    payload (1 byte/elem after padding `shard_elems` to a block
+    multiple) plus its f32 scales.  Same convention as the ledger:
+    all-gather wire = result bytes * (n_gran - 1) / n_gran."""
+    if n_gran <= 1:
+        return 0.0
+    if mode == "fp32":
+        return float(shard_bytes * (n_gran - 1))
+    e = shard_elems + (-shard_elems % block)
+    return float((e + e // block * 4) * (n_gran - 1))

@@ -235,9 +235,11 @@ class ZeroEngine:
         grad_comm_groups: Optional[int] = None,
         grad_comm_error_feedback: bool = True,
         grad_buckets: int = 1,
+        grad_comm_tail: str = "fp32",
         gather_prefetch: int = 0,
         gather_groups: Optional[int] = None,
         hpz: bool = False,
+        hpz_comm: str = "fp32",
         hpz_granule_of: Optional[Dict[int, int]] = None,
     ):
         """seq_parallel > 1 carves a "seq" mesh axis out of the devices:
@@ -552,9 +554,15 @@ class ZeroEngine:
         # real composition runs the merged composed_step machine.
         from . import schedule as _sched
         from .comm import GRAD_COMM_MODES
-        if grad_comm not in GRAD_COMM_MODES:
+        # "auto" = DCN-aware sizing: build_schedule derives the codec /
+        # bucket count / inner-group factor from the mesh's granule map
+        # (parallel/schedule.auto_comm_plan); the resolved values are
+        # read back onto the engine attrs after the build below
+        _auto = any(v == "auto"
+                    for v in (grad_comm, grad_buckets, gather_groups))
+        if grad_comm not in GRAD_COMM_MODES and grad_comm != "auto":
             raise ValueError(
-                f"grad_comm must be one of {GRAD_COMM_MODES}, "
+                f"grad_comm must be one of {GRAD_COMM_MODES} or 'auto', "
                 f"got {grad_comm!r}"
             )
         self.grad_comm = grad_comm
@@ -570,11 +578,18 @@ class ZeroEngine:
                 "(grad_comm='fp32' runs no quantized schedule)"
             )
         self.grad_comm_error_feedback = bool(grad_comm_error_feedback)
-        self.grad_buckets = int(grad_buckets) if grad_buckets else 1
-        if self.grad_buckets < 1:
+        self.grad_buckets = grad_buckets if grad_buckets == "auto" \
+            else (int(grad_buckets) if grad_buckets else 1)
+        if self.grad_buckets != "auto" and self.grad_buckets < 1:
             raise ValueError(
                 f"grad_buckets must be >= 1, got {grad_buckets}"
             )
+        if grad_comm_tail not in GRAD_COMM_MODES:
+            raise ValueError(
+                f"grad_comm_tail must be one of {GRAD_COMM_MODES}, "
+                f"got {grad_comm_tail!r}"
+            )
+        self.grad_comm_tail = grad_comm_tail
         self.gather_prefetch = int(gather_prefetch) if gather_prefetch \
             else 0
         if self.gather_prefetch < 0:
@@ -582,8 +597,10 @@ class ZeroEngine:
                 f"gather_prefetch must be >= 0 (0/1 = the on-demand "
                 f"gather; K >= 2 holds K layers), got {gather_prefetch}"
             )
-        self.gather_groups = int(gather_groups) if gather_groups else None
-        if self.gather_groups and self.gather_prefetch <= 1:
+        self.gather_groups = gather_groups if gather_groups == "auto" \
+            else (int(gather_groups) if gather_groups else None)
+        if self.gather_groups and self.gather_groups != "auto" \
+                and self.gather_prefetch <= 1:
             # loud rejection, not a silently-flat gather mislabeled
             # as the 2-hop schedule (the grad_comm_groups convention)
             raise ValueError(
@@ -592,8 +609,14 @@ class ZeroEngine:
                 "schedule)"
             )
         self.hpz = bool(hpz)
+        if hpz_comm not in GRAD_COMM_MODES:
+            raise ValueError(
+                f"hpz_comm must be one of {GRAD_COMM_MODES}, "
+                f"got {hpz_comm!r}"
+            )
+        self.hpz_comm = hpz_comm
         granule_of = hpz_granule_of
-        if self.hpz and granule_of is None:
+        if (self.hpz or _auto) and granule_of is None:
             from .mesh import granule_map
             granule_of = granule_map(mesh.devices.flatten())
 
@@ -620,14 +643,23 @@ class ZeroEngine:
             grad_comm_groups=self.grad_comm_groups,
             grad_comm_error_feedback=self.grad_comm_error_feedback,
             grad_buckets=self.grad_buckets,
+            grad_comm_tail=self.grad_comm_tail,
             gather_prefetch=self.gather_prefetch,
             gather_groups=self.gather_groups,
-            hpz=self.hpz, granule_of=granule_of,
+            hpz=self.hpz, hpz_comm=self.hpz_comm,
+            granule_of=granule_of,
             telemetry_layers=self._layers_on,
             pipeline=self.pipe_axis is not None or self._use_1f1b,
         )
         self._lowering = self._schedule.lowering
         sg, sr = self._schedule.gather, self._schedule.grad
+        if _auto:
+            # read the DCN-aware plan's resolved values back so
+            # describe()/telemetry/checkpoints see concrete knobs, never
+            # the "auto" sentinel
+            self.grad_comm = sr.mode if sr is not None else "fp32"
+            self.grad_buckets = sr.buckets if sr is not None else 1
+            self.gather_groups = sg.groups if sg is not None else None
         self._grad_comm_active = sr is not None and sr.mode != "fp32"
         self._bucketed_active = sr is not None and sr.buckets > 1
         self._gather_prefetch_active = sg is not None and sg.prefetch > 1
@@ -1424,6 +1456,8 @@ class ZeroEngine:
                 extras += f"(2-hop inner={self.grad_comm_groups})"
             if not self.grad_comm_error_feedback:
                 extras += "(no-ef)"
+            if getattr(self, "grad_comm_tail", "fp32") != "fp32":
+                extras += f", grad_comm_tail={self.grad_comm_tail}"
         if self._bucketed_active:
             extras += f", grad_buckets={self.grad_buckets}"
         if self._gather_prefetch_active:
@@ -1432,6 +1466,8 @@ class ZeroEngine:
                 extras += f"(2-hop inner={self.gather_groups})"
         if getattr(self, "hpz", False):
             extras += ", hpz=on"
+            if getattr(self, "hpz_comm", "fp32") != "fp32":
+                extras += f"[{self.hpz_comm}]"
         if getattr(self, "_lowering", "plain") not in ("plain",):
             extras += f", sched={self._schedule.describe()}"
         return (

@@ -237,6 +237,21 @@ def granule_map(devices) -> Optional[dict]:
     return {i: ix[getattr(d, attr)] for i, d in enumerate(devices)}
 
 
+def granule_geometry(granule_of: Optional[dict], n: int) -> tuple:
+    """(n_granules, ici) of a granule map over an n-rank data axis — the
+    link hierarchy the DCN-aware "auto" comm sizing keys on
+    (parallel/schedule.auto_comm_plan).  A None / empty map is the flat
+    single-slice mesh: (1, n).  `ici` is the intra-granule rank count
+    when the granules split `n` evenly, else `n` (an uneven map gets no
+    2-hop sizing — the schedule-level validators own the loud refusal)."""
+    if not granule_of:
+        return 1, n
+    n_gran = len(set(granule_of.values()))
+    if n_gran <= 1 or n % n_gran:
+        return max(n_gran, 1), n
+    return n_gran, n // n_gran
+
+
 def mesh_descriptor(mesh: Mesh) -> dict:
     """JSON-safe identity of a mesh's shape: axis names/sizes, device and
     host counts.  Persisted in checkpoint meta sidecars so an elastic

@@ -523,8 +523,21 @@ class Telemetry:
                     "sched_grad_overlap_frac",
                     overlap["grad_comm_overlap_frac"],
                 )
+            if sched.grad is not None and sched.grad.tail_mode != "fp32":
+                # quantized ZeRO-3 tail release: the tail's sync runs
+                # once per step OUTSIDE the scans (the bucket syncs are
+                # the in-loop reduce wire), so outside-loop reduce wire
+                # IS the tail release — comparable against the fp32
+                # path's transpose reduce-scatter on the same number
+                self.gauge(
+                    "zero3_tail_wire_bytes",
+                    overlap["reduce_wire_bytes_total"]
+                    - overlap["reduce_wire_bytes_in_loops"],
+                )
             if granule_of is not None:
-                from ..utils.hlo_comm import gather_link_split_in_loops
+                from ..utils.hlo_comm import (
+                    gather_link_split_in_loops, group_wire_outside_loops,
+                )
                 in_scan = gather_link_split_in_loops(led, granule_of)
                 measured["wire_bytes_by_link_in_scan_gather"] = in_scan
                 if sched.gather is not None and sched.gather.hpz:
@@ -532,6 +545,16 @@ class Telemetry:
                         "hpz_dcn_wire_bytes",
                         in_scan["dcn_wire_bytes"],
                     )
+                    # the rebuild hop itself, isolated by exact group
+                    # match on the scheduler's inter groups (qwZ fp8
+                    # acceptance: ~4x lower than the fp32 rebuild)
+                    if sched.hpz_geom is not None:
+                        self.gauge(
+                            "hpz_rebuild_dcn_bytes",
+                            group_wire_outside_loops(
+                                led, sched.hpz_geom[1]
+                            ),
+                        )
         modeled = float(model_rep.get("total_bytes_per_step", 0.0))
         if modeled > 0:
             out["comm_delta"] = round(

@@ -110,7 +110,21 @@ _NUM = (int, float)
 #      trace_view next to the wire-sized collective spans) — all
 #      emitted only when the cost ledger ran, so older files stay
 #      byte-compatible with v11 readers
-SCHEMA_VERSION = 12
+#  13: + the wire agenda close-out (quantized ZeRO-3 tail + qwZ hpZ
+#      rebuild, parallel/schedule.py): composed engines additionally
+#      gauge zero3_tail_wire_bytes (the once-per-step OUTSIDE-loop
+#      reduce wire = the tail release, emitted when grad_comm_tail is
+#      quantized) and hpz_rebuild_dcn_bytes (the hpZ secondary
+#      rebuild's inter-granule all-gather wire isolated by exact
+#      replica-group match, utils/hlo_comm.group_wire_outside_loops —
+#      ~4x lower under hpz_comm='fp8', ZeRO++ arXiv:2306.10209);
+#      run_meta's comm_model may carry zero3_tail_release_bytes /
+#      hpz_rebuild_bytes (the modeled counterparts) and autotune plans
+#      may carry the comm knob space (grad_comm/grad_buckets/
+#      grad_comm_tail/gather_groups/hpz/hpz_comm) — all emitted only
+#      by engines running the new knobs, so older files stay
+#      byte-compatible with v12 readers
+SCHEMA_VERSION = 13
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -534,6 +548,21 @@ GAUGES: Dict[str, str] = {
                           "partitioning, where every in-scan gather "
                           "stays intra-slice and only the one "
                           "top-level secondary rebuild crosses DCN",
+    "hpz_rebuild_dcn_bytes": "the hpZ secondary rebuild hop itself: "
+                             "outside-loop all-gather wire on exactly "
+                             "the scheduler's inter-granule replica "
+                             "groups (utils/hlo_comm."
+                             "group_wire_outside_loops) — the qwZ "
+                             "number, ~4x lower under hpz_comm='fp8' "
+                             "(fp8 blocks + scales instead of compute "
+                             "dtype, ZeRO++ arXiv:2306.10209)",
+    "zero3_tail_wire_bytes": "quantized ZeRO-3 tail release: the "
+                             "once-per-step outside-loop reduce wire "
+                             "(the non-block tail's sync; the bucket "
+                             "syncs are the in-loop reduce wire) — "
+                             "emitted when grad_comm_tail is "
+                             "quantized, comparable against the fp32 "
+                             "transpose reduce-scatter it replaces",
     "serve_spec_accept_rate": "speculative decoding: drafts accepted / "
                               "drafts proposed, engine lifetime — the "
                               "drafter-quality number that decides "

@@ -679,6 +679,37 @@ def gather_link_split_in_loops(led: Dict[str, object],
     return wire_link_split({"wire_bytes_by_groups": merged}, granule_of)
 
 
+def group_wire_outside_loops(led: Dict[str, object],
+                             groups) -> float:
+    """Wire bytes of the OUTSIDE-loop collectives whose replica groups
+    match `groups` exactly (an iterable of participant-id iterables,
+    order-insensitive).  This isolates ONE named hop: e.g. the hpZ
+    secondary rebuild's inter-granule all_gather rides exactly the
+    `inter` groups the scheduler built, and nothing else outside the
+    scan shares them — so (total wire on those groups) minus (the
+    in-loop wire on them) IS the rebuild's bytes, undiluted by the
+    tail gathers / grad syncs that share the DCN link but run on
+    different groups.  The qwZ acceptance pin (fp8 rebuild ~4x lower
+    than fp32) reads this number."""
+    want = tuple(sorted(tuple(sorted(int(d) for d in g))
+                        for g in groups))
+    total = 0.0
+    for members, w in led.get("wire_bytes_by_groups", {}).items():
+        if members is None:
+            continue
+        if tuple(sorted(tuple(sorted(g)) for g in members)) == want:
+            total += w
+    in_loops = 0.0
+    per_op = led.get("wire_bytes_by_op_groups_in_loops", {})
+    for op, per in per_op.items():
+        for members, w in per.items():
+            if members is None:
+                continue
+            if tuple(sorted(tuple(sorted(g)) for g in members)) == want:
+                in_loops += w
+    return float(max(total - in_loops, 0.0))
+
+
 def ledger_summary(led: Dict[str, object],
                    granule_of: Optional[Dict[int, int]] = None
                    ) -> Dict[str, object]:

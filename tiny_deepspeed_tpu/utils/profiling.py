@@ -293,10 +293,24 @@ def comm_report(engine) -> Dict[str, float]:
     # per step (accumulation syncs once, so no n_sync multiplier), priced
     # by the same ring conventions via comm.modeled_wire_bytes
     quant = bool(getattr(engine, "_grad_comm_active", False))
+    tmode = str(getattr(engine, "grad_comm_tail", "fp32"))
+    # composed ZeRO-3: the non-block tail is RELEASED SEPARATELY from
+    # the codec'd block syncs — through the differentiable gather's
+    # fp32 transpose, or (grad_comm_tail != fp32) its own quantized
+    # sync.  Price it under zero3_tail_release_bytes, not inside the
+    # grad codec model (round-5 ledger finding: the old qt term billed
+    # the tail to the block codec and missed the fp32 transpose).
+    z3_split_tail = quant and stage == 3
+    tail_elems_total = sum(
+        int(np.prod(s.shape)) for nm, s in shapes.items()
+        if not nm.startswith("h.")
+    )
     quant_model = None
     if quant:
         from ..parallel.comm import modeled_wire_bytes
         n_elems = sum(int(np.prod(s.shape)) for s in shapes.values())
+        if z3_split_tail:
+            n_elems -= tail_elems_total
         quant_model = modeled_wire_bytes(
             n_elems, n, engine.grad_comm,
             block=engine.grad_comm_block,
@@ -319,8 +333,8 @@ def comm_report(engine) -> Dict[str, float]:
                 lay["tail_elems"], n, engine.grad_comm,
                 block=engine.grad_comm_block,
                 inner=engine.grad_comm_groups,
-            ) if lay["tail_elems"] else {"elems_padded": 0,
-                                         "quant_wire_bytes": 0.0}
+            ) if (lay["tail_elems"] and not z3_split_tail) else {
+                "elems_padded": 0, "quant_wire_bytes": 0.0}
             k = lay["n_buckets"]
             quant_model = dict(
                 quant_model,
@@ -329,6 +343,46 @@ def comm_report(engine) -> Dict[str, float]:
                 quant_wire_bytes=k * qb["quant_wire_bytes"]
                 + qt["quant_wire_bytes"],
             )
+    # the composed ZeRO-3 tail release itself (once per step, outside
+    # the scans): fp32 = the transpose reduce-scatter on sharded leaves
+    # (param dtype) + the explicit psum on replicated ones; quantized =
+    # comm.modeled_wire_bytes on the tail's elems under the tail codec
+    zero3_tail_release = 0.0
+    if z3_split_tail:
+        if tmode == "fp32":
+            spec_rest = getattr(engine, "_param_spec_rest", {}) or {}
+            for nm, s in shapes.items():
+                if nm.startswith("h."):
+                    continue
+                b = int(np.prod(s.shape)) * int(jnp.dtype(s.dtype).itemsize)
+                spec = spec_rest.get(nm)
+                sharded = spec is not None and any(
+                    d is not None for d in tuple(spec)
+                )
+                # reduce-scatter g*ring vs all-reduce 2g*ring
+                zero3_tail_release += (1 if sharded else 2) * b * ring
+        else:
+            from ..parallel.comm import modeled_wire_bytes
+            zero3_tail_release = modeled_wire_bytes(
+                tail_elems_total, n, tmode,
+                block=engine.grad_comm_block,
+            )["quant_wire_bytes"]
+    # hpZ secondary rebuild (qwZ): the once-per-step inter-granule
+    # all-gather of this rank's resting shard — compute-dtype bytes at
+    # fp32, fp8 blocks + scales under hpz_comm='fp8'
+    hpz_rebuild = 0.0
+    geom = getattr(getattr(engine, "_schedule", None), "hpz_geom", None)
+    if getattr(engine, "hpz", False) and geom is not None and stage == 3:
+        from ..parallel.comm import modeled_hpz_rebuild_bytes
+        n_gran = geom[3]
+        block_elems = sum(
+            int(np.prod(s.shape)) for nm, s in shapes.items()
+            if nm.startswith("h.")
+        )
+        hpz_rebuild = modeled_hpz_rebuild_bytes(
+            block_cd // n, block_elems // n, n_gran,
+            str(getattr(engine, "hpz_comm", "fp32")),
+        )
     # gather_prefetch (parallel/schedule.GatherPrefetchScan): the explicit
     # prefetched schedule issues K-1 extra clamped end-of-scan gathers
     # per pass (fwd + remat bwd each run L+K-1 layer gathers), and
@@ -373,6 +427,12 @@ def comm_report(engine) -> Dict[str, float]:
         # bwd; non-block params once — all at compute precision (plus the
         # prefetch overshoot / 2-hop reroute when gather_prefetch is on)
         "zero3_layer_gather_bytes": z3_gather,
+        # composed ZeRO-3 tail release + hpZ secondary rebuild — the
+        # wire-agenda hops, modeled at the same ring conventions the
+        # ledger measures (zero3_tail_wire_bytes /
+        # hpz_rebuild_dcn_bytes gauges)
+        "zero3_tail_release_bytes": zero3_tail_release,
+        "hpz_rebuild_bytes": hpz_rebuild,
     }
     report["total_bytes_per_step"] = sum(
         v for k, v in report.items()
