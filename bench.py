@@ -121,6 +121,12 @@ def _config_fingerprint(env=None) -> str:
         "tail_quant": env.get("BENCH_TAIL_QUANT", ""),
         "hpz_comm": env.get("BENCH_HPZ_COMM", ""),
         "comm_auto": env.get("BENCH_COMM_AUTO", ""),
+        # pipeline-schedule A/B arms (1f1b vs interleaved:V vs zbub:V
+        # at fixed stages/microbatches): the schedule is the measured
+        # quantity, so it must fingerprint the cache rows apart
+        "pipe_sched": env.get("BENCH_PIPE_SCHED", ""),
+        "pipe_stages": env.get("BENCH_PIPE_STAGES", ""),
+        "pipe_mb": env.get("BENCH_PIPE_MB", ""),
     }, sort_keys=True)
 
 
@@ -485,6 +491,26 @@ def _sched_extra(engine, compiled_step, hpz_gran=None):
         "reduce_wire_bytes_in_loops": rep["reduce_wire_bytes_in_loops"],
     }
     sched = engine._schedule
+    if sched.pipe_program is not None:
+        # table pipeline arms: the compiled tick program's occupancy —
+        # perf_diff.py sentinel-flags bubble_frac like the wire keys, so
+        # a schedule regression (bubble creeping back up) reads as a
+        # diff line, not silence
+        out["pipe"] = sched.pipe_program.describe()
+        out["bubble_frac"] = round(
+            float(sched.pipe_program.bubble_frac), 6)
+        out["pipe_ticks"] = int(sched.pipe_program.n_ticks)
+    elif getattr(engine, "_use_1f1b", False):
+        # the 1f1b baseline arm has no tick table; its bubble is the
+        # closed form — stamped so the three-arm A/B reads side by side
+        from tiny_deepspeed_tpu.parallel.pipe_schedule import (
+            analytic_1f1b_bubble,
+        )
+        s = int(engine.mesh.shape.get("pipe", 0) or 0)
+        m = int(engine.pctx.pipe_microbatches or s)
+        if s >= 2:
+            out["pipe"] = f"pipe=1f1b[s={s} m={m} analytic]"
+            out["bubble_frac"] = round(analytic_1f1b_bubble(s, m), 6)
     if sched.grad is not None and sched.grad.tail_mode != "fp32":
         # quantized tail release: its sync is the once-per-step
         # OUTSIDE-loop reduce wire (buckets are the in-loop wire)
@@ -636,6 +662,24 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
         # grad codec (defaults int8 when no explicit BENCH_GRAD_COMM)
         ek["grad_comm"] = os.environ.get("BENCH_GRAD_COMM") or "int8"
         ek["grad_comm_tail"] = os.environ["BENCH_TAIL_QUANT"]
+    pipe_sched_arm = os.environ.get("BENCH_PIPE_SCHED")
+    if pipe_sched_arm:
+        # pipeline-schedule A/B arm: "1f1b" vs "interleaved:V" vs
+        # "zbub[:V]" at FIXED stages and microbatches — the schedule is
+        # the only variable across the three rows (the fingerprint keeps
+        # them apart), and extra.sched.bubble_frac carries the compiled
+        # tick program's occupancy for perf_diff's sentinel
+        stages = int(os.environ.get("BENCH_PIPE_STAGES") or 0) or \
+            min(4, n_chips)
+        if n_chips % stages:
+            raise SystemExit(
+                f"bench: BENCH_PIPE_STAGES={stages} must divide the "
+                f"chip count {n_chips}"
+            )
+        ek["pipeline_parallel"] = stages
+        ek["pipeline_schedule"] = pipe_sched_arm
+        ek["pipeline_microbatches"] = int(
+            os.environ.get("BENCH_PIPE_MB") or 2 * stages)
     if sched_compose:
         # round-9 A/B: the scheduler-composed FULL STACK (ZeRO-3 +
         # gather prefetch + bucketed quantized grads + per-layer
@@ -674,7 +718,15 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
             # wire-agenda arm: qwZ — the secondary rebuild's
             # inter-granule all_gather moves fp8 blocks + scales
             ek["hpz_comm"] = os.environ["BENCH_HPZ_COMM"]
-    if (gather_prefetch or sched_compose or bench_hpz
+    if pipe_sched_arm:
+        # the engine carves the (data, pipe) mesh itself — the premade
+        # flat mesh above has no pipe axis.  Zero1 keeps the optimizer
+        # sharded without pulling in the gather/grad slots the table
+        # schedules refuse to compose with.
+        from tiny_deepspeed_tpu import Zero1
+        engine = Zero1(model, opt, **ek)
+        b *= n_chips
+    elif (gather_prefetch or sched_compose or bench_hpz
             or os.environ.get("BENCH_TAIL_QUANT")
             or os.environ.get("BENCH_COMM_AUTO")):
         from tiny_deepspeed_tpu import Zero3
@@ -887,7 +939,7 @@ def run_one(model_name: str, b=None, t=1024, iters=30):
                                       gather_prefetch, gather_quant)
                if gather_prefetch else {}),
             **(_sched_extra(engine, compiled_step, hpz_gran)
-               if (sched_compose or bench_hpz
+               if (sched_compose or bench_hpz or pipe_sched_arm
                    or os.environ.get("BENCH_TAIL_QUANT")
                    or os.environ.get("BENCH_COMM_AUTO")) else {}),
             "effective": {

@@ -147,10 +147,34 @@ def parse_args(default_model="gpt2-124m", **defaults):
         help="in-flight pipeline microbatches (default PP; raise to "
              "amortize the (PP-1)/(M+PP-1) bubble)",
     )
+    def _pipeline_schedule_arg(v):
+        kind = v.partition(":")[0]
+        if kind not in ("gpipe", "1f1b", "interleaved", "zbub"):
+            raise argparse.ArgumentTypeError(
+                f"{v!r}: schedule must be gpipe, 1f1b, interleaved or "
+                f"zbub, optionally with a ':V' virtual-stage suffix "
+                f"(e.g. interleaved:2)"
+            )
+        return v
+
     p.add_argument(
-        "--pipeline-schedule", choices=("gpipe", "1f1b"), default="gpipe",
-        help="gpipe (autodiff, O(M) in-flight activations) or 1f1b "
-             "(combined fwd/bwd tick scan, O(PP) — raise M freely)",
+        "--pipeline-schedule",
+        type=_pipeline_schedule_arg, default="gpipe", metavar="KIND[:V]",
+        help="gpipe (autodiff, O(M) in-flight activations), 1f1b "
+             "(combined fwd/bwd tick scan, O(PP) — raise M freely), or "
+             "the table-driven schedules: interleaved (each stage holds "
+             "V virtual chunks, --pipeline-virtual) and zbub "
+             "(interleaved + zero-bubble backward split: dgrad on the "
+             "critical path, wgrad fills the cooldown bubble) — both "
+             "shrink the measured bubble_frac below 1f1b's "
+             "(PP-1)/(M+PP-1)",
+    )
+    p.add_argument(
+        "--pipeline-virtual", type=int, default=1, metavar="V",
+        help="virtual chunks per stage for "
+             "--pipeline-schedule interleaved/zbub (n_layer must divide "
+             "by PP*V; the `--sched pipe=interleaved:V` spelling sets "
+             "this too)",
     )
     p.add_argument(
         "--offload-opt-state", action="store_true",
@@ -458,9 +482,19 @@ def run(engine_cls, args, single_device=False):
         gather_groups=getattr(args, "gather_groups", None),
     )
     train_kw.update(sched_kw)
+    # `--sched pipe=KIND:V` lands in sched_kw as pipeline_schedule /
+    # pipeline_virtual — pop them so they win over the legacy flags
+    # without colliding with the explicit ctor kwargs below
+    pipe_sched = train_kw.pop(
+        "pipeline_schedule", getattr(args, "pipeline_schedule", "gpipe")
+    )
+    pipe_virtual = train_kw.pop(
+        "pipeline_virtual", getattr(args, "pipeline_virtual", 1)
+    )
     if single_device:
         engine = engine_cls(
             model, opt, mesh=make_mesh(devices=[jax.devices()[0]]),
+            pipeline_schedule=pipe_sched, pipeline_virtual=pipe_virtual,
             **train_kw,
         )
         n_dev = 1
@@ -475,7 +509,7 @@ def run(engine_cls, args, single_device=False):
             pipeline_parallel=getattr(args, "pipeline_parallel", 1),
             pipeline_microbatches=getattr(args, "pipeline_microbatches", 0)
             or None,
-            pipeline_schedule=getattr(args, "pipeline_schedule", "gpipe"),
+            pipeline_schedule=pipe_sched, pipeline_virtual=pipe_virtual,
             **train_kw,
         )
         n_dev = engine.n_dev
@@ -791,17 +825,20 @@ def run(engine_cls, args, single_device=False):
             ))
             spans = telem.trace_spans()
             cspans = telem.compute_trace_spans()
-            if spans or cspans:
+            pipe_tr = telem.pipe_trace(engine)
+            if spans or cspans or pipe_tr:
                 # step-trace span template (telemetry/trace.py): the
                 # compiled step's collectives by (op, loop residency)
                 # with exact ledger wire bytes, plus the compute spans
-                # sized by HLO-counted FLOPs (utils/hlo_cost.py) —
-                # scripts/trace_view.py joins both with the per-step
-                # wall segments above
+                # sized by HLO-counted FLOPs (utils/hlo_cost.py) and —
+                # under a table pipeline schedule — the tick program's
+                # per-stage rows; scripts/trace_view.py joins all three
+                # with the per-step wall segments above
                 metrics.log_meta(
                     kind="trace",
                     **({"spans": spans} if spans else {}),
                     **({"compute_spans": cspans} if cspans else {}),
+                    **({"pipe": pipe_tr} if pipe_tr else {}),
                 )
         if ran:
             # per-host straggler attribution over the UNCOUPLED host-side

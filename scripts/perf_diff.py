@@ -69,6 +69,11 @@ _WIRE_KEYS = (
     ("sched.hpz_rebuild_dcn_bytes", "hpz rebuild DCN wire"),
     ("sched.wire_bytes_by_link.ici_wire_bytes", "ICI wire"),
     ("sched.wire_bytes_by_link.dcn_wire_bytes", "DCN wire"),
+    # not wire, but the same deterministic-per-program contract: the
+    # compiled pipeline tick program's idle fraction (pipe-schedule
+    # arms) — a bubble creeping back up is a schedule regression the
+    # clock on a CPU mesh never notices
+    ("sched.bubble_frac", "pipeline bubble frac"),
 )
 
 

@@ -210,4 +210,18 @@ log "   comm auto rc=$? $(cat "$OUT/bench_comm_auto.json" 2>/dev/null | head -c 
 timeout 3000 env BENCH_TUNE_E2E=1 python bench.py > "$OUT/bench_tune_e2e_comm.json" 2> "$OUT/bench_tune_e2e_comm.err"
 log "   tune_e2e (comm phase) rc=$? $(cat "$OUT/bench_tune_e2e_comm.json" 2>/dev/null | head -c 240)"
 
+log "21. pipeline schedule A/B (round-19: table-driven interleaved /"
+log "    zero-bubble schedules, parallel/pipe_schedule.py — three arms"
+log "    at FIXED stages=4 and M=8 so the schedule is the only variable;"
+log "    extra.sched.bubble_frac carries the compiled tick program's"
+log "    idle fraction (1f1b analytic (S-1)/(M+S-1)=0.273 here) and"
+log "    perf_diff sentinels it like the wire keys.  n_layer must divide"
+log "    stages*virtual — the 124m default (12 layers) refuses V=2, so"
+log "    these arms pin gpt2-350m (24 layers)"
+for ps in 1f1b interleaved:2 zbub:2; do
+  tag=$(echo "$ps" | tr ':' '_')
+  timeout 2400 env BENCH_MODEL=gpt2-350m BENCH_PIPE_SCHED=$ps BENCH_PIPE_STAGES=4 BENCH_PIPE_MB=8 python bench.py > "$OUT/bench_pipe_$tag.json" 2> "$OUT/bench_pipe_$tag.err"
+  log "   pipe $ps rc=$? $(cat "$OUT/bench_pipe_$tag.json" 2>/dev/null | head -c 200)"
+done
+
 log "batch complete; results in $OUT"

@@ -191,10 +191,14 @@ class TestRefusals:
                            match="gather slot.*2-hop"):
             _build(model, stage=3, gather_prefetch=2, gather_groups=2,
                    telemetry_layers=True)
-        with pytest.raises(S.ScheduleConflictError,
-                           match="grad slot.*2-hop"):
-            _build(model, stage=3, gather_prefetch=2, grad_comm="int8",
-                   grad_comm_groups=2)
+        # the grad side's 2-hop refusal is LIFTED: the composed release
+        # threads the hierarchical codec (inner=) through its bucket and
+        # tail syncs, so the combination now BUILDS on the composed
+        # machine instead of refusing
+        sched = _build(model, stage=3, gather_prefetch=2,
+                       grad_comm="int8", grad_comm_groups=2)
+        assert sched.lowering == "composed"
+        assert "2-hop inner=2" in sched.describe()
 
     def test_moe_named_with_slot(self):
         from tiny_deepspeed_tpu.models.moe import MoEConfig, MoEGPT
@@ -383,6 +387,20 @@ class TestFullStackCompose:
         lay = eng._schedule.layout
         assert state.grad_residual.shape == (
             8, 2 * lay["bucket_pad"])
+
+    def test_two_hop_grad_compose_parity(self, model):
+        """The lifted grad x 2-hop refusal actually TRAINS: the
+        composed release threads the hierarchical codec (inner=)
+        through its bucket and tail syncs — parity vs plain ZeRO-3
+        within the quantized tolerance."""
+        base, _ = run_curve(Zero3(model, AdamW(lr=1e-3)))
+        eng = Zero3(model, AdamW(lr=1e-3), gather_prefetch=2,
+                    grad_comm="int8", grad_comm_groups=2)
+        assert eng._lowering == "composed"
+        assert "2-hop inner=2" in eng._schedule.describe()
+        comp, _ = run_curve(eng)
+        assert abs(comp[-1] - base[-1]) / abs(base[-1]) < 0.05
+        assert comp[-1] < comp[0]
 
     def test_probe_stats_match_plain_probe_lowering(self, model):
         """Review pin: the composed probe reports the SAME LAYER_FIELDS

@@ -1132,6 +1132,75 @@ class GPT2Model:
         grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
         return loss, grads
 
+    # Table-driven schedules (interleaved virtual stages / zero-bubble
+    # B/W split) reuse the 1F1B seams but need an aux-free block: MoEGPT
+    # opts out (its load-balance aux would need to ride every F *and* be
+    # replayed in W's re-linearization).
+    supports_pipe_table = True
+
+    def loss_and_grad_pipe(self, params, idx, targets, pctx, program,
+                           loss_seed=1.0, rng=None):
+        """(scaled loss, grads) via a static pipeline tick table
+        (parallel/pipeline.py::spmd_pipeline_table) — interleaved and
+        zero-bubble schedules.  Same contract and seam composition as
+        `loss_and_grad_1f1b`: the pipeline hands back cotangents at the
+        stacked/head/embed seams and explicit vjps push them to the
+        master params."""
+        if pctx is None or pctx.pipe_axis is None:
+            raise ValueError("loss_and_grad_pipe needs a pipeline pctx")
+        from ..parallel.pipeline import spmd_pipeline_table
+
+        block, aux_w, with_aux = self._pipeline_1f1b_block(pctx)
+        if with_aux or aux_w:
+            raise ValueError("table schedules do not thread aux losses; "
+                             "use pipeline_schedule='1f1b'")
+        drop_keys = None
+        c = self.config
+        if rng is not None and c.dropout:
+            keys = jax.random.split(rng, c.n_layer + 1)
+            drop_keys = keys[1:]
+
+            def embed_fn(p):
+                return _dropout(self.embed(p, idx, pctx), keys[0],
+                                c.dropout)
+        else:
+            def embed_fn(p):
+                return self.embed(p, idx, pctx)
+        x, embed_vjp = jax.vjp(embed_fn, params)
+        stacked, stacked_vjp = jax.vjp(self.stacked_compute_params, params)
+        head_names = [n for n in self.head_param_names() if n in params]
+        head_params = {n: params[n] for n in head_names}
+
+        def head_fn(hp, y, tg):
+            # one-hot CE for the same partial-manual reason as 1F1B
+            from ..ops.softmax_xent import softmax_cross_entropy_onehot
+            from ..ops.linear import linear
+            h = self.final_norm(hp, y)
+            return softmax_cross_entropy_onehot(
+                linear(h, self._lm_head_w(hp), None), tg
+            )
+
+        loss, dstacked, dhead, dx = spmd_pipeline_table(
+            block, head_fn, stacked, head_params,
+            x, targets,
+            mesh=pctx.mesh,
+            program=program,
+            pipe_axis=pctx.pipe_axis or "pipe",
+            data_axis=pctx.data_axis,
+            loss_seed=loss_seed,
+            rng_stacked=drop_keys,
+        )
+        g_embed = embed_vjp(dx.astype(x.dtype))[0]
+        g_stack = stacked_vjp(dstacked)[0]
+        grads = jax.tree.map(
+            lambda a, b: a.astype(jnp.float32) + b.astype(jnp.float32),
+            g_embed, g_stack,
+        )
+        for n, g in dhead.items():
+            grads[n] = grads[n] + g.astype(jnp.float32)
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
+        return loss, grads
+
     def generate(self, params, idx, max_new_tokens: int, *,
                  temperature: float = 1.0, top_k: Optional[int] = None,
                  key=None, use_cache: bool = True):

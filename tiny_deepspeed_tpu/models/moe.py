@@ -145,6 +145,10 @@ class MoEGPT(GPT2Model):
     # output of the layer slab (pipeline.py with_aux), so MoE runs the
     # O(S)-memory schedule too
     supports_1f1b = True
+    # ...but NOT the table schedules (interleaved/zbub): the aux loss
+    # would have to ride every F tick and replay in W's re-linearization
+    # — build_schedule refuses, naming the pipe slot
+    supports_pipe_table = False
 
     def _block_aux_fn(self, pctx):
         """(x, bp) -> (x, aux) with the remat policy applied — shared by

@@ -224,6 +224,7 @@ class ZeroEngine:
         pipeline_parallel: int = 1,
         pipeline_microbatches: Optional[int] = None,
         pipeline_schedule: str = "gpipe",
+        pipeline_virtual: int = 1,
         grad_clip: Optional[float] = None,
         loss_scale=None,
         loss_scale_growth_interval: int = 2000,
@@ -263,6 +264,16 @@ class ZeroEngine:
         amortize the bubble without the activation bill; MoE aux loss,
         dropout, fp8 weight gather, and ring/Ulysses sequence
         parallelism all compose — see pipeline.py::spmd_pipeline_1f1b).
+        "interleaved[:V]" and "zbub[:V]" run the table-driven executor
+        instead (pipeline.py::spmd_pipeline_table): each stage holds V
+        virtual model chunks (pipeline_virtual, or the ':V' suffix) and
+        a static (tick, stage) -> {F/B/W, chunk, microbatch} program
+        compiled by parallel/pipe_schedule.py drives a lax.switch per
+        tick; "zbub" further splits backward into dgrad (critical path)
+        and wgrad (bubble filler).  Both cut the pipeline bubble below
+        1F1B's (S-1)/(M+S-1) — measured by the `bubble_frac` gauge —
+        but compose with fewer knobs: the composed scheduler's pipe
+        slot names each unsupported pairing (ScheduleConflictError).
 
         grad_clip: clip gradients to this global L2 norm (computed across
         every leaf; under ZeRO-2/3 the per-leaf square-sums run on the
@@ -487,23 +498,44 @@ class ZeroEngine:
                 "forward (pipeline_capable=False); pipeline_parallel would "
                 "silently run un-pipelined with the layer axis sharded"
             )
-        if pipeline_schedule not in ("gpipe", "1f1b"):
-            raise ValueError(f"pipeline_schedule must be 'gpipe' or "
-                             f"'1f1b', got {pipeline_schedule!r}")
-        self._use_1f1b = pipeline_schedule == "1f1b"
-        if self._use_1f1b:
+        # "interleaved:2" / "zbub:2" carry the virtual-stage count V in
+        # the spec itself (the parse_sched_spec `pipe=KIND:V` form); an
+        # explicit pipeline_virtual kwarg covers the programmatic path
+        _psched = pipeline_schedule
+        if ":" in _psched:
+            _psched, _, _pv = _psched.partition(":")
+            try:
+                pipeline_virtual = int(_pv)
+            except ValueError:
+                raise ValueError(
+                    f"pipeline_schedule {pipeline_schedule!r}: the ':V' "
+                    f"suffix must be an integer virtual-stage count"
+                ) from None
+        if _psched not in ("gpipe", "1f1b", "interleaved", "zbub"):
+            raise ValueError(
+                f"pipeline_schedule must be 'gpipe', '1f1b', "
+                f"'interleaved[:V]' or 'zbub[:V]', got "
+                f"{pipeline_schedule!r}")
+        self._use_1f1b = _psched == "1f1b"
+        # table-driven schedules (interleaved / zero-bubble) compile a
+        # static tick program via the composed scheduler's pipe slot
+        self._use_pipe_table = _psched in ("interleaved", "zbub")
+        self._pipe_kind = _psched
+        self._pipe_virtual = max(int(pipeline_virtual), 1)
+        if self._use_1f1b or self._use_pipe_table:
             # reject rather than silently run un-pipelined autodiff — a
             # user benchmarking "1f1b" must get the 1f1b code path
             if self.pipe_axis is None:
                 raise ValueError(
-                    "pipeline_schedule='1f1b' requires pipeline_parallel "
-                    "> 1 (no 'pipe' mesh axis is active)"
+                    f"pipeline_schedule={_psched!r} requires "
+                    "pipeline_parallel > 1 (no 'pipe' mesh axis is "
+                    "active)"
                 )
-            if not getattr(model, "supports_1f1b", False):
-                raise ValueError(
-                    f"{type(model).__name__} does not support the 1F1B "
-                    "schedule (no loss_and_grad_1f1b); use 'gpipe'"
-                )
+        if self._use_1f1b and not getattr(model, "supports_1f1b", False):
+            raise ValueError(
+                f"{type(model).__name__} does not support the 1F1B "
+                "schedule (no loss_and_grad_1f1b); use 'gpipe'"
+            )
         if seq_impl not in ("ring", "ulysses"):
             raise ValueError(f"seq_impl must be 'ring' or 'ulysses', "
                              f"got {seq_impl!r}")
@@ -650,6 +682,12 @@ class ZeroEngine:
             granule_of=granule_of,
             telemetry_layers=self._layers_on,
             pipeline=self.pipe_axis is not None or self._use_1f1b,
+            pipe_schedule=(self._pipe_kind if self._use_pipe_table
+                           else None),
+            pipe_stages=(mesh.shape[self.pipe_axis]
+                         if self.pipe_axis is not None else 0),
+            pipe_virtual=self._pipe_virtual,
+            pipe_microbatches=self.pctx.pipe_microbatches,
         )
         self._lowering = self._schedule.lowering
         sg, sr = self._schedule.gather, self._schedule.grad
@@ -1145,6 +1183,18 @@ class ZeroEngine:
 
         def loss_and_grads(p, ix, tg, rng=None):
             """(loss, grads, probe cotangent or None)."""
+            if self._use_pipe_table:
+                # grads computed INSIDE the tick table (per-op vjp) —
+                # the interleaved/zero-bubble program is a static
+                # (tick, stage) schedule compiled by build_schedule
+                # (parallel/pipe_schedule.py), not autodiff output
+                l, g = self.model.loss_and_grad_pipe(
+                    p, ix, tg, pctx=self.pctx,
+                    program=self._schedule.pipe_program,
+                    loss_seed=scale if scale is not None else 1.0,
+                    rng=rng,
+                )
+                return l, g, None
             if self._use_1f1b:
                 # grads computed INSIDE the pipeline (per-tick vjp) — the
                 # 1F1B schedule can't be expressed through autodiff
@@ -1259,7 +1309,7 @@ class ZeroEngine:
 
         if scale is not None:
             loss = loss / scale
-            if self._lowering in ("plain", "probe", "prefetch"):
+            if self._lowering in ("plain", "probe", "prefetch", "pipe"):
                 # the explicit-schedule lowerings (composed / bucket /
                 # quant_mono) already unscaled before their collectives
                 grads = _rescale(grads, 1.0 / scale)

@@ -1,0 +1,324 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Declarative pipeline tick tables: interleaved (virtual-stage) and
+zero-bubble (B/W split) schedules as STATIC programs.
+
+The GPipe and 1F1B executors in `pipeline.py` hard-code their schedules
+as closed-form index arithmetic inside the tick scan.  That stops
+scaling the moment the schedule has structure the formula cannot carry:
+virtual stages (each physical stage owns V non-adjacent layer chunks,
+Megatron-LM's bubble reducer) and backward-split scheduling (dgrad B on
+the critical path, wgrad W as bubble filler — the zero-bubble family,
+arXiv:2412.14374).  This module builds those schedules OFFLINE as a
+(tick, stage) -> {F/B/W, chunk, microbatch} table plus a static stash
+slot map, so the executor (`pipeline.spmd_pipeline_table`) is a dumb
+table interpreter and the schedule itself is a pure, testable object —
+`build_schedule`'s PipeSlot client validates it once per engine build.
+
+Everything here is numpy/python: no jax import, no device, no compile.
+`tests/test_pipeline_schedule.py` pins warmup/steady/cooldown shapes,
+the analytic 1F1B bubble (S-1)/(M+S-1), and the measured bubble ordering
+1f1b > interleaved > zbub without touching a mesh.
+
+Scheduling model (unit ticks, the occupancy ledger `bubble_frac` reads):
+
+  * one op per (tick, stage); F, B and W each cost one tick
+  * chunk c of C = S*V lives on stage c % S (local index v = c // S);
+    forward hops ride the +1 ring, cotangents the -1 ring, one tick
+  * F(c,j) needs F(c-1,j) arrived; B(C-1,j) needs F(C-1,j) stashed
+    (the head runs inside the final chunk's backward); B(c,j) needs
+    B(c+1,j)'s cotangent; W(c,j) needs B(c,j) (same stage, no hop)
+  * the table is built by event-driven greedy list scheduling with
+    priorities B > F > W: B is the critical path, F drains toward the
+    loss (highest chunk first), W is pure filler that soaks warmup /
+    cooldown bubbles.  For V=1 without the split this reproduces the
+    textbook 1F1B table exactly — T = 2(M+S-1) ticks, bubble
+    (S-1)/(M+S-1) — which is the regression anchor for the whole
+    builder.
+
+`bubble_frac` is the idle fraction of the (T x S) tick grid.  Ticks are
+schedule slots, not equal wall time — the gauge measures the *schedule*,
+the A/B bench arm measures the wall.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+# op codes in the (tick, stage) table — also the lax.switch branch index
+# order in pipeline.spmd_pipeline_table (idle first so padding is a no-op)
+OP_IDLE = 0
+OP_F = 1
+OP_B = 2
+OP_W = 3
+
+OP_NAMES = {OP_IDLE: "-", OP_F: "F", OP_B: "B", OP_W: "W"}
+
+
+@dataclasses.dataclass(frozen=True)
+class PipeProgram:
+    """A compiled pipeline schedule: the static tick table the executor
+    interprets plus its occupancy ledger.
+
+    All (n_ticks, stages) int32 arrays; -1 means "none" in slot columns.
+
+      op      OP_IDLE / OP_F / OP_B / OP_W
+      vchunk  local chunk index v on this stage (global chunk v*S+stage)
+      mb      microbatch index j
+      aslot   activation stash slot the op reads (F input / B,W recompute)
+      cslot   cotangent stash slot B/W reads (-1: final chunk, head-seeded)
+      recv_f  slot an arriving forward activation parks into this tick
+      recv_b  slot an arriving backward cotangent parks into this tick
+
+    `ka` / `kc` size the two stash rings; `busy` is per-stage scheduled
+    ticks; `bubble_frac` = 1 - sum(busy) / (n_ticks * stages).
+    """
+
+    stages: int
+    virtual: int
+    microbatches: int
+    split_w: bool
+    n_ticks: int
+    ka: int
+    kc: int
+    op: np.ndarray
+    vchunk: np.ndarray
+    mb: np.ndarray
+    aslot: np.ndarray
+    cslot: np.ndarray
+    recv_f: np.ndarray
+    recv_b: np.ndarray
+    busy: np.ndarray
+    bubble_frac: float
+
+    @property
+    def chunks(self) -> int:
+        return self.stages * self.virtual
+
+    def describe(self) -> str:
+        kind = "zbub" if self.split_w else (
+            "interleaved" if self.virtual > 1 else "1f1b")
+        return (f"pipe={kind}:{self.virtual}[s={self.stages} "
+                f"m={self.microbatches} t={self.n_ticks} "
+                f"bubble={self.bubble_frac:.3f}]")
+
+    def render(self) -> str:
+        """ASCII tick table (stages x ticks), for docs and debugging:
+        `F0.2` = forward, local chunk 0, microbatch 2."""
+        rows = []
+        for s in range(self.stages):
+            cells = []
+            for t in range(self.n_ticks):
+                o = int(self.op[t, s])
+                if o == OP_IDLE:
+                    cells.append("....")
+                else:
+                    cells.append(f"{OP_NAMES[o]}{int(self.vchunk[t, s])}."
+                                 f"{int(self.mb[t, s])}")
+            rows.append(f"s{s}: " + " ".join(cells))
+        return "\n".join(rows)
+
+
+def _validate_geometry(s: int, v: int, m: int,
+                       n_layer: Optional[int]) -> None:
+    if s < 2:
+        raise ValueError(
+            f"pipeline table needs >= 2 stages, got {s} (a 1-stage "
+            f"'pipeline' is a plain scan — use the non-pipelined path)")
+    if v < 1:
+        raise ValueError(f"virtual stages must be >= 1, got {v}")
+    if m < 1:
+        raise ValueError(f"microbatches must be >= 1, got {m}")
+    if n_layer is not None and n_layer % (s * v):
+        raise ValueError(
+            f"n_layer={n_layer} not divisible by stages*virtual="
+            f"{s}*{v}={s * v} (each of the {s * v} chunks must hold the "
+            f"same number of layers)")
+
+
+def build_pipe_program(
+    s: int,
+    v: int,
+    m: int,
+    *,
+    split_w: bool = False,
+    n_layer: Optional[int] = None,
+) -> PipeProgram:
+    """Build the (tick, stage) program for S physical stages, V virtual
+    chunks per stage, M microbatches; `split_w` enables the zero-bubble
+    B/W split.  Pure python — raises ValueError on bad geometry."""
+    _validate_geometry(s, v, m, n_layer)
+    c_total = s * v
+
+    # completion tick of each op, keyed (kind, chunk, microbatch)
+    t_f = np.full((c_total, m), -1, np.int64)
+    t_b = np.full((c_total, m), -1, np.int64)
+    t_w = np.full((c_total, m), -1, np.int64)
+
+    # per-stage chunk lists: stage s owns global chunks s, s+S, ...
+    stage_chunks = [list(range(st, c_total, s)) for st in range(s)]
+
+    sched = []  # (tick, stage, opcode, chunk, mb)
+    n_ops = c_total * m * (3 if split_w else 2)
+    done = 0
+    t = 0
+    cap = 4 * c_total * m + 8 * (s + v) + 16
+    while done < n_ops:
+        t += 1
+        if t > cap:  # pragma: no cover - guards builder bugs, not inputs
+            raise RuntimeError(
+                f"pipeline schedule did not converge in {cap} ticks "
+                f"(s={s} v={v} m={m} split_w={split_w})")
+        for st in range(s):
+            best = None  # (priority tuple, opcode, chunk, mb)
+            for c in stage_chunks[st]:
+                for j in range(m):
+                    if t_f[c, j] < 0:
+                        # in-order per chunk; upstream chunk arrived
+                        if j > 0 and t_f[c, j - 1] < 0:
+                            break
+                        if c > 0 and not (0 <= t_f[c - 1, j] < t):
+                            break
+                        # B beats F beats W; F drains toward the loss
+                        # (highest chunk first), oldest microbatch first
+                        key = (1, -c, j)
+                        if best is None or key < best[0]:
+                            best = (key, OP_F, c, j)
+                        break  # only the first unscheduled j is a candidate
+                for j in range(m):
+                    if t_b[c, j] < 0:
+                        if j > 0 and t_b[c, j - 1] < 0:
+                            break
+                        if t_f[c, j] < 0:
+                            break
+                        if c < c_total - 1 and not (0 <= t_b[c + 1, j] < t):
+                            break
+                        key = (0, j, -c)
+                        if best is None or key < best[0]:
+                            best = (key, OP_B, c, j)
+                        break
+                if split_w:
+                    for j in range(m):
+                        if t_w[c, j] < 0:
+                            if not (0 <= t_b[c, j] < t):
+                                break
+                            key = (2, j, -c)
+                            if best is None or key < best[0]:
+                                best = (key, OP_W, c, j)
+                            break
+            if best is None:
+                continue
+            _, opc, c, j = best
+            {OP_F: t_f, OP_B: t_b, OP_W: t_w}[opc][c, j] = t
+            sched.append((t, st, opc, c, j))
+            done += 1
+
+    n_ticks = t
+    op = np.zeros((n_ticks, s), np.int32)
+    vchunk = np.zeros((n_ticks, s), np.int32)
+    mbt = np.zeros((n_ticks, s), np.int32)
+    aslot = np.full((n_ticks, s), -1, np.int32)
+    cslot = np.full((n_ticks, s), -1, np.int32)
+    recv_f = np.full((n_ticks, s), -1, np.int32)
+    recv_b = np.full((n_ticks, s), -1, np.int32)
+    busy = np.zeros((s,), np.int64)
+    for tt, st, opc, c, j in sched:
+        op[tt - 1, st] = opc
+        vchunk[tt - 1, st] = c // s
+        mbt[tt - 1, st] = j
+        busy[st] += 1
+
+    # -- static stash allocation: interval-graph coloring per stage ------
+    # activation (c,j): parked at the forward arrival (the F tick itself
+    # for chunk 0's injection), read by F and again by the recompute in
+    # B (and W when split); the slot frees the tick AFTER its last read
+    # (an arrival parks before the op runs, so same-tick reuse collides)
+    def color(intervals):
+        """intervals: list of (start, end, key) per stage, inclusive
+        ticks.  Returns ({key: slot}, n_slots)."""
+        slot_of = {}
+        free_at = []  # slot -> first tick it is free again
+        for start, end, key in sorted(intervals):
+            for sl, fa in enumerate(free_at):
+                if fa <= start:
+                    free_at[sl] = end + 1
+                    slot_of[key] = sl
+                    break
+            else:
+                slot_of[key] = len(free_at)
+                free_at.append(end + 1)
+        return slot_of, len(free_at)
+
+    ka = kc = 0
+    for st in range(s):
+        a_iv, c_iv = [], []
+        for c in stage_chunks[st]:
+            for j in range(m):
+                a_start = t_f[c, j] if c == 0 else t_f[c - 1, j] + 1
+                a_end = t_w[c, j] if split_w else t_b[c, j]
+                a_iv.append((int(a_start), int(a_end), (c, j)))
+                if c < c_total - 1:
+                    c_start = t_b[c + 1, j] + 1
+                    c_end = t_w[c, j] if split_w else t_b[c, j]
+                    c_iv.append((int(c_start), int(c_end), (c, j)))
+        a_slot, n_a = color(a_iv)
+        c_slot, n_c = color(c_iv)
+        ka, kc = max(ka, n_a), max(kc, n_c)
+        for c in stage_chunks[st]:
+            for j in range(m):
+                sl = a_slot[(c, j)]
+                for tb in (t_f[c, j], t_b[c, j]) + (
+                        (t_w[c, j],) if split_w else ()):
+                    aslot[tb - 1, st] = sl
+                if c > 0:
+                    recv_f[t_f[c - 1, j], st] = sl  # arrival tick - 1 idx
+                if c < c_total - 1:
+                    cl = c_slot[(c, j)]
+                    for tb in (t_b[c, j],) + (
+                            (t_w[c, j],) if split_w else ()):
+                        cslot[tb - 1, st] = cl
+                    recv_b[t_b[c + 1, j], st] = cl
+
+    bubble = 1.0 - float(busy.sum()) / float(n_ticks * s)
+    return PipeProgram(
+        stages=s, virtual=v, microbatches=m, split_w=split_w,
+        n_ticks=n_ticks, ka=max(ka, 1), kc=max(kc, 1),
+        op=op, vchunk=vchunk, mb=mbt, aslot=aslot, cslot=cslot,
+        recv_f=recv_f, recv_b=recv_b, busy=busy,
+        bubble_frac=bubble,
+    )
+
+
+def analytic_1f1b_bubble(s: int, m: int) -> float:
+    """The textbook 1F1B idle fraction (S-1)/(M+S-1) — what the builder
+    must reproduce at V=1 without the B/W split."""
+    return (s - 1) / (m + s - 1)
+
+
+def chunk_permutation(n_layer: int, s: int,
+                      v: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Layer permutation realizing virtual stages on a pipe-sharded
+    stacked array.
+
+    Canonical layer l belongs to global chunk g = l // (n_layer/(S*V));
+    chunk g lives on stage g % S at local index g // S.  `perm` reorders
+    the canonical layer axis so a plain P(pipe) shard of the permuted
+    array hands stage s exactly its chunks, contiguously by local index:
+    permuted position p = s*(L/S) + (g//S)*Lc + (l % Lc) holds canonical
+    layer perm[p].  `inv` undoes it (dstacked = dperm[inv]).  Identity
+    when V == 1 — callers skip the reshuffle entirely then."""
+    _validate_geometry(s, v, 1, n_layer)
+    lc = n_layer // (s * v)
+    perm = np.empty(n_layer, np.int64)
+    for st in range(s):
+        for vv in range(v):
+            g = vv * s + st
+            for i in range(lc):
+                perm[st * (n_layer // s) + vv * lc + i] = g * lc + i
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(n_layer)
+    return perm, inv

@@ -250,15 +250,14 @@ def spmd_pipeline_1f1b(
     Total ticks M + 2S - 1 — the same O(M + S) wall clock as GPipe's
     fwd+bwd pair; what changes is the memory bound, not the bubble.
 
-    Interleaved/virtual-stage scheduling (Megatron's bubble reducer) is a
-    DELIBERATE non-goal: its payoff is a smaller bubble at FIXED M, but
-    under this schedule M can simply grow — activation memory stays O(S) —
-    until the bubble (S-1)/(M+2S-2) is amortized away, which covers every
-    case where the global batch allows more microbatches.  Realizing
-    virtual stages under SPMD would also force the stacked layer axis into
-    a permuted storage layout (device s owning non-contiguous chunks
-    {s, s+S, ...}) that every non-pipelined consumer (plain scan, GPipe,
-    eval, checkpoints) would then have to unpermute per step.
+    Interleaved/virtual-stage and zero-bubble scheduling live in
+    `spmd_pipeline_table` below: the schedule is a static (tick, stage)
+    program built by `pipe_schedule.build_pipe_program` and this 1F1B
+    loop stays the closed-form fast path (HLO-identical when the table
+    knobs are off).  The permuted-storage objection that once made
+    virtual stages a non-goal is answered by permuting per step INSIDE
+    the pipelined loss: canonical layer order everywhere else
+    (checkpoints, eval, plain scan), one gather in/out per step.
 
     block_fn:    (x, block_params) -> x, or -> (x, aux scalar) with
                  `with_aux` (MoE load-balance loss).
@@ -528,6 +527,365 @@ def spmd_pipeline_1f1b(
     )
     dhead = jax.tree.map(
         lambda g, v: g.astype(v.dtype), dhead, head_params
+    )
+    dx = dx.reshape(b, *x.shape[1:]).astype(dtype)
+    return loss, dstacked, dhead, dx
+
+
+def spmd_pipeline_table(
+    block_fn,
+    head_fn,
+    stacked,
+    head_params,
+    x,
+    targets,
+    *,
+    mesh: Mesh,
+    program,
+    pipe_axis: str = "pipe",
+    data_axis: Optional[str] = "data",
+    loss_seed=1.0,
+    rng_stacked=None,
+):
+    """Table-driven pipeline executor: interprets a static (tick, stage)
+    program from `pipe_schedule.build_pipe_program` — interleaved
+    virtual stages and the zero-bubble B/W split — with the same return
+    contract as `spmd_pipeline_1f1b`.
+
+    Where 1F1B's tick scan derives its schedule from closed-form index
+    arithmetic, this scan reads it off the program's per-tick rows (scan
+    xs): opcode, local chunk, microbatch, stash slots, arrival parking.
+    Each physical stage owns V layer chunks; global chunk c lives on
+    stage c % S, so the stacked layer axis is PERMUTED into chunk order
+    outside the shard_map (one gather per step, V > 1 only; gradients
+    inverse-permute on the way out — storage everywhere else stays
+    canonical).  Hops ride full +1/-1 ppermute rings every tick with
+    masked zero payloads on non-sending stages; the receiving stage's
+    recv_f/recv_b columns park arrivals into stash slots before the
+    tick's op runs, so an op at tick t can consume a tick t arrival.
+
+    Per tick each stage runs ONE op via `lax.switch` (idle/F/B[/W]); the
+    branch index and the final-chunk `lax.cond` inside B/W vary only
+    with the pipe coordinate — uniform across the non-manual mesh axes,
+    so GSPMD-inserted collectives inside branches agree across their
+    groups (the 1F1B head-cond precedent).  B recomputes the chunk
+    forward from the activation stash (jax.vjp); the final chunk's B
+    runs the head inside that vjp, seeding the backward with the loss
+    cotangent directly.  Under the zero-bubble split, B differentiates
+    only the chunk INPUT (dgrad, critical path) and W re-linearizes from
+    the same stash to differentiate the weights (wgrad, bubble filler) —
+    one extra recompute per chunk on this remat-based expression; a
+    chip-resident variant would stash the linearization instead.
+
+    Not supported (refused by the PipeSlot in build_schedule): MoE aux
+    losses and sequence parallelism.
+
+    Returns (loss, dstacked, dhead, dx) exactly like `spmd_pipeline_1f1b`
+    — scaled by `loss_seed`, microbatch-mean, grads in param dtypes.
+    """
+    s = mesh.shape[pipe_axis]
+    if s != program.stages:
+        raise ValueError(f"program built for {program.stages} stages, "
+                         f"mesh pipe axis has {s}")
+    v = program.virtual
+    m = program.microbatches
+    b = x.shape[0]
+    n_layer = jax.tree.leaves(stacked)[0].shape[0]
+    if n_layer % (s * v):
+        raise ValueError(f"n_layer={n_layer} not divisible by "
+                         f"stages*virtual={s * v}")
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    lc = n_layer // (s * v)          # layers per chunk
+    c_total = s * v
+    dtype = x.dtype
+    f32 = jnp.float32
+    seed = jnp.asarray(loss_seed, f32)
+
+    def slab_fwd(loc, xi, keys=None):
+        """One chunk's layer slab (cf. 1F1B's slab_fwd, aux-free)."""
+        xs = loc if keys is None else (loc, keys)
+
+        def body(c, bp):
+            if keys is not None:
+                w, kk = bp
+                bp = dict(w, dropout_rng=kk)
+            return block_fn(c, bp), None
+
+        return jax.lax.scan(body, xi, xs)[0]
+
+    # chunk-order permutation of the layer axis (identity at V=1): the
+    # permuted array's plain P(pipe) shard hands stage s chunks
+    # {s, s+S, ...} contiguously by local index
+    if v > 1:
+        from .pipe_schedule import chunk_permutation
+        perm_np, inv_np = chunk_permutation(n_layer, s, v)
+        perm = jnp.asarray(perm_np)
+        stacked_p = jax.tree.map(lambda a: jnp.take(a, perm, 0), stacked)
+        rng_p = (None if rng_stacked is None
+                 else jnp.take(rng_stacked, perm, 0))
+    else:
+        inv_np = None
+        stacked_p = stacked
+        rng_p = rng_stacked
+
+    mb = b // m
+    xmb = x.reshape(m, mb, *x.shape[1:])
+    tmb = targets.reshape(m, mb, *targets.shape[1:])
+    if data_axis is not None and data_axis in mesh.axis_names:
+        xmb = jax.lax.with_sharding_constraint(
+            xmb, NamedSharding(mesh, P(None, data_axis))
+        )
+        tmb = jax.lax.with_sharding_constraint(
+            tmb, NamedSharding(mesh, P(None, data_axis))
+        )
+
+    # per-tick table rows ride the scan as xs; each stage indexes its
+    # column (the program is tiny static metadata, not device state)
+    table = dict(
+        op=jnp.asarray(program.op),
+        vchunk=jnp.asarray(program.vchunk),
+        mb=jnp.asarray(program.mb),
+        aslot=jnp.asarray(program.aslot),
+        cslot=jnp.asarray(program.cslot),
+        recv_f=jnp.asarray(program.recv_f),
+        recv_b=jnp.asarray(program.recv_b),
+    )
+
+    def local(stacked_loc, head_loc, xmb, tmb, seed, rng_loc=None):
+        stage = jax.lax.axis_index(pipe_axis)
+        shift_fwd = [(i, (i + 1) % s) for i in range(s)]
+        shift_bwd = [(i, (i - 1) % s) for i in range(s)]
+        act_shape = xmb.shape[1:]
+        zero_act = jnp.zeros(act_shape, dtype)
+
+        def zeros_f32(tree):
+            return jax.tree.map(lambda t: jnp.zeros(t.shape, f32), tree)
+
+        carry0 = dict(
+            fw=zero_act,                  # fwd activation on the wire
+            bw=zero_act,                  # bwd cotangent on the wire
+            astash=jnp.zeros((program.ka,) + act_shape, dtype),
+            cstash=jnp.zeros((program.kc,) + act_shape, dtype),
+            dslab=zeros_f32(stacked_loc),
+            dhead=zeros_f32(head_loc),
+            dx=jnp.zeros((m,) + act_shape, f32),
+            loss=jnp.zeros((), f32),
+        )
+
+        def tick(c, row):
+            col = {k: r[stage] for k, r in row.items()}
+            # -- park arrivals BEFORE the op: a tick t op may consume a
+            # tick t arrival (builder frees slots only the tick after
+            # their last read, so parking never clobbers a live slot)
+            astash = jnp.where(
+                col["recv_f"] >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    c["astash"], c["fw"], jnp.maximum(col["recv_f"], 0), 0
+                ),
+                c["astash"],
+            )
+            cstash = jnp.where(
+                col["recv_b"] >= 0,
+                jax.lax.dynamic_update_index_in_dim(
+                    c["cstash"], c["bw"], jnp.maximum(col["recv_b"], 0), 0
+                ),
+                c["cstash"],
+            )
+
+            vv = col["vchunk"]
+            j = col["mb"]
+            asl = jnp.maximum(col["aslot"], 0)
+            csl = jnp.maximum(col["cslot"], 0)
+            gchunk = vv * s + stage       # global chunk of this tick's op
+            is_final = gchunk == c_total - 1
+            is_first = gchunk == 0
+            slab = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, vv * lc, lc, 0),
+                stacked_loc,
+            )
+            keys = None
+            if rng_loc is not None:
+                keys = jax.vmap(lambda kk: jax.random.fold_in(kk, j))(
+                    jax.lax.dynamic_slice_in_dim(rng_loc, vv * lc, lc, 0)
+                )
+            x_in = jax.lax.dynamic_index_in_dim(
+                astash, asl, 0, keepdims=False
+            )
+            cot = jax.lax.dynamic_index_in_dim(
+                cstash, csl, 0, keepdims=False
+            )
+            tg = jax.lax.dynamic_index_in_dim(tmb, j, 0, keepdims=False)
+
+            def acc_slab(acc, dsl):
+                def upd(a, g):
+                    cur = jax.lax.dynamic_slice_in_dim(a, vv * lc, lc, 0)
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        a, cur + g.astype(f32), vv * lc, 0
+                    )
+                return jax.tree.map(upd, acc, dsl)
+
+            # branches return the full updated tick state:
+            # (astash, cstash, dslab, dhead, dx, loss, send_f, send_b)
+            def br_idle(_):
+                return (astash, cstash, c["dslab"], c["dhead"], c["dx"],
+                        c["loss"], zero_act, zero_act)
+
+            def br_f(_):
+                xin = jnp.where(
+                    is_first,
+                    jax.lax.dynamic_index_in_dim(xmb, j, 0, keepdims=False),
+                    x_in,
+                )
+                # chunk 0 has no upstream arrival: its F stashes the
+                # injected microbatch itself for the later recompute
+                ast = jnp.where(
+                    is_first,
+                    jax.lax.dynamic_update_index_in_dim(astash, xin, asl, 0),
+                    astash,
+                )
+                y = slab_fwd(slab, xin, keys)
+                send = jnp.where(is_final, zero_act, y)
+                return (ast, cstash, c["dslab"], c["dhead"], c["dx"],
+                        c["loss"], send, zero_act)
+
+            def br_b(_):
+                if not program.split_w:
+                    # combined backward: one vjp yields wgrad + dgrad
+                    def fin(_):
+                        def f(sl, hp, xi):
+                            return head_fn(
+                                hp, slab_fwd(sl, xi, keys), tg
+                            ).astype(f32)
+                        lj, vjp = jax.vjp(f, slab, head_loc, x_in)
+                        dsl, dhp, dxi = vjp(seed)
+                        return (lj,
+                                jax.tree.map(lambda g: g.astype(f32), dsl),
+                                jax.tree.map(lambda g: g.astype(f32), dhp),
+                                dxi)
+
+                    def non(_):
+                        _, vjp = jax.vjp(
+                            lambda sl, xi: slab_fwd(sl, xi, keys),
+                            slab, x_in,
+                        )
+                        dsl, dxi = vjp(cot)
+                        return (jnp.zeros((), f32),
+                                jax.tree.map(lambda g: g.astype(f32), dsl),
+                                zeros_f32(head_loc), dxi)
+
+                    lj, dsl, dhp, dxi = jax.lax.cond(is_final, fin, non,
+                                                     None)
+                    dslab = acc_slab(c["dslab"], dsl)
+                    dhead = jax.tree.map(lambda a, g: a + g, c["dhead"],
+                                         dhp)
+                else:
+                    # zero-bubble dgrad: differentiate the chunk INPUT
+                    # only; W re-linearizes for the weights later
+                    def fin(_):
+                        lj, vjp = jax.vjp(
+                            lambda xi: head_fn(
+                                head_loc, slab_fwd(slab, xi, keys), tg
+                            ).astype(f32),
+                            x_in,
+                        )
+                        (dxi,) = vjp(seed)
+                        return lj, dxi
+
+                    def non(_):
+                        _, vjp = jax.vjp(
+                            lambda xi: slab_fwd(slab, xi, keys), x_in
+                        )
+                        (dxi,) = vjp(cot)
+                        return jnp.zeros((), f32), dxi
+
+                    lj, dxi = jax.lax.cond(is_final, fin, non, None)
+                    dslab, dhead = c["dslab"], c["dhead"]
+                loss = c["loss"] + lj * seed
+                dx = jnp.where(
+                    is_first,
+                    jax.lax.dynamic_update_index_in_dim(
+                        c["dx"], dxi.astype(f32), j, 0
+                    ),
+                    c["dx"],
+                )
+                send = jnp.where(is_first, zero_act, dxi.astype(dtype))
+                return (astash, cstash, dslab, dhead, dx, loss,
+                        zero_act, send)
+
+            def br_w(_):
+                # zero-bubble wgrad: re-linearize from the stashed input,
+                # differentiate weights (+ head on the final chunk)
+                def fin(_):
+                    _, vjp = jax.vjp(
+                        lambda sl, hp: head_fn(
+                            hp, slab_fwd(sl, x_in, keys), tg
+                        ).astype(f32),
+                        slab, head_loc,
+                    )
+                    dsl, dhp = vjp(seed)
+                    return (jax.tree.map(lambda g: g.astype(f32), dsl),
+                            jax.tree.map(lambda g: g.astype(f32), dhp))
+
+                def non(_):
+                    _, vjp = jax.vjp(
+                        lambda sl: slab_fwd(sl, x_in, keys), slab
+                    )
+                    (dsl,) = vjp(cot)
+                    return (jax.tree.map(lambda g: g.astype(f32), dsl),
+                            zeros_f32(head_loc))
+
+                dsl, dhp = jax.lax.cond(is_final, fin, non, None)
+                return (astash, cstash, acc_slab(c["dslab"], dsl),
+                        jax.tree.map(lambda a, g: a + g, c["dhead"], dhp),
+                        c["dx"], c["loss"], zero_act, zero_act)
+
+            branches = [br_idle, br_f, br_b]
+            if program.split_w:
+                branches.append(br_w)
+            (astash, cstash, dslab, dhead, dx, loss, send_f,
+             send_b) = jax.lax.switch(col["op"], branches, None)
+            # hops OUTSIDE the switch: full rings, masked zero payloads
+            fw = jax.lax.ppermute(send_f, pipe_axis, shift_fwd)
+            bw = jax.lax.ppermute(send_b, pipe_axis, shift_bwd)
+            return dict(fw=fw, bw=bw, astash=astash, cstash=cstash,
+                        dslab=dslab, dhead=dhead, dx=dx, loss=loss), None
+
+        c, _ = jax.lax.scan(tick, carry0, table)
+        # loss/dhead live on the head stage, dx on stage 0; psum
+        # broadcasts (f32 — the CPU AllReducePromotion constraint, and
+        # the right accumulation dtype anyway)
+        loss = jax.lax.psum(c["loss"], pipe_axis) / m
+        dhead = jax.tree.map(
+            lambda g: jax.lax.psum(g, pipe_axis) / m, c["dhead"]
+        )
+        dx = jax.lax.psum(c["dx"], pipe_axis) / m
+        dslab = jax.tree.map(lambda g: g / m, c["dslab"])
+        return loss, dslab, dhead, dx
+
+    specs = jax.tree.map(lambda _: P(pipe_axis), stacked_p)
+    head_specs = jax.tree.map(lambda _: P(), head_params)
+    args = [stacked_p, head_params, xmb, tmb, seed]
+    in_specs = [specs, head_specs, P(), P(), P()]
+    if rng_p is not None:
+        args.append(rng_p)
+        in_specs.append(P(pipe_axis))
+    loss, dslab, dhead, dx = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=(P(), specs, head_specs, P()),
+        axis_names={pipe_axis},
+        check_vma=False,
+    )(*args)
+    if inv_np is not None:
+        inv = jnp.asarray(inv_np)
+        dslab = jax.tree.map(lambda g: jnp.take(g, inv, 0), dslab)
+    dstacked = jax.tree.map(
+        lambda g, vr: g.astype(vr.dtype), dslab, stacked
+    )
+    dhead = jax.tree.map(
+        lambda g, vr: g.astype(vr.dtype), dhead, head_params
     )
     dx = dx.reshape(b, *x.shape[1:]).astype(dtype)
     return loss, dstacked, dhead, dx

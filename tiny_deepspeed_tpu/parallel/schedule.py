@@ -116,8 +116,10 @@ class GatherSlot:
 class GradSlot:
     """Gradient releases: `buckets` layer buckets (+ non-block tail),
     collective codec `mode` with `block`-sized absmax scales and optional
-    error-feedback residual slices; `groups` = 2-hop schedule inner size
-    (legacy monolithic lowering only)."""
+    error-feedback residual slices; `groups` = 2-hop hierarchical
+    schedule inner size (monolithic AND composed lowerings — every
+    quantized release inside composed_step passes it down to
+    quantized_grad_sync's inner/outer split)."""
     buckets: int = 1
     mode: str = "fp32"
     block: int = DEFAULT_BLOCK
@@ -151,6 +153,23 @@ class ProbeSlot:
         return "health"
 
 
+@dataclasses.dataclass(frozen=True)
+class PipeSlot:
+    """Table-driven pipeline schedule (parallel/pipe_schedule.py):
+    `kind` = "interleaved" (virtual stages, combined backward) or "zbub"
+    (zero-bubble B/W split); `virtual` chunks per physical stage.  The
+    validated Schedule carries the compiled tick program alongside —
+    the engine's step interprets it via pipeline.spmd_pipeline_table."""
+    kind: str = "interleaved"
+    virtual: int = 1
+    stages: int = 0
+    microbatches: int = 0
+
+    def describe(self) -> str:
+        return (f"pipe={self.kind}:{self.virtual}"
+                f"[m={self.microbatches}]")
+
+
 # ---------------------------------------------------------------------------
 # --sched spec parsing (examples/common.py, ONE translation site)
 # ---------------------------------------------------------------------------
@@ -168,6 +187,11 @@ def parse_sched_spec(spec: str) -> Dict[str, Any]:
     extend the codec vocabulary to the composed ZeRO-3 tail release and
     the hpZ secondary rebuild.
 
+    `pipe=KIND[:V]` selects the pipeline schedule slot: `pipe=gpipe`,
+    `pipe=1f1b`, `pipe=interleaved:2` (V virtual chunks per stage,
+    default 2), `pipe=zbub[:V]` (zero-bubble B/W split, default V=1) —
+    translated to `pipeline_schedule` / `pipeline_virtual` engine kwargs.
+
     `telemetry_layers` is not an engine kwarg — the caller upgrades its
     Telemetry to layers=True (examples/common.py does)."""
     out: Dict[str, Any] = {}
@@ -175,6 +199,7 @@ def parse_sched_spec(spec: str) -> Dict[str, Any]:
                 "grad_comm_groups", "grad_comm_block")
     auto_ok = ("gather_groups", "grad_buckets", "grad_comm")
     mode_keys = ("grad_comm", "grad_comm_tail", "hpz_comm")
+    pipe_kinds = ("gpipe", "1f1b", "interleaved", "zbub")
     for part in (p.strip() for p in spec.split(",") if p.strip()):
         if part == "health":
             out["telemetry_layers"] = True
@@ -188,6 +213,19 @@ def parse_sched_spec(spec: str) -> Dict[str, Any]:
                 f"or 'hpz'"
             )
         key, val = (s.strip() for s in part.split("=", 1))
+        if key == "pipe":
+            kind, _, vtxt = val.partition(":")
+            if kind not in pipe_kinds:
+                raise ValueError(
+                    f"--sched pipe must be one of {pipe_kinds} "
+                    f"(optionally KIND:V), got {val!r}"
+                )
+            out["pipeline_schedule"] = kind
+            if vtxt:
+                out["pipeline_virtual"] = int(vtxt)
+            elif kind == "interleaved":
+                out["pipeline_virtual"] = 2
+            continue
         if val == "auto" and key in auto_ok:
             out[key] = "auto"
         elif key in int_keys:
@@ -826,6 +864,12 @@ class Schedule:
     gather: Optional[GatherSlot] = None
     grad: Optional[GradSlot] = None
     probe: Optional[ProbeSlot] = None
+    pipe: Optional[PipeSlot] = None
+    # the compiled tick table (pipe_schedule.PipeProgram) when a pipe
+    # slot is declared — validated once here, interpreted per step by
+    # pipeline.spmd_pipeline_table; its bubble_frac is the telemetry
+    # gauge's source of truth
+    pipe_program: Optional[object] = None
     lowering: str = "plain"
     # grad-slot geometry (parallel/comm.bucket_layout) when a grad slot
     # is declared; None otherwise
@@ -844,7 +888,8 @@ class Schedule:
 
     @property
     def slots(self):
-        return [s for s in (self.gather, self.grad, self.probe)
+        return [s for s in (self.gather, self.grad, self.probe,
+                            self.pipe)
                 if s is not None]
 
     def describe(self) -> str:
@@ -867,6 +912,8 @@ def build_schedule(
     hpz: bool = False, hpz_comm: str = "fp32",
     granule_of: Optional[Dict[int, int]] = None,
     telemetry_layers: bool = False, pipeline: bool = False,
+    pipe_schedule: Optional[str] = None, pipe_stages: int = 0,
+    pipe_virtual: int = 1, pipe_microbatches: int = 0,
 ) -> Schedule:
     """Translate engine knobs into slot declarations, validate the
     composition ONCE, and pick the lowering.
@@ -971,6 +1018,63 @@ def build_schedule(
     if stage >= 3 and grad is not None and gather is None:
         gather = GatherSlot(prefetch=1)
 
+    # ---- pipe slot: table-driven schedules validate + compile here ---------
+    if pipe_schedule in ("interleaved", "zbub"):
+        pipe = PipeSlot(
+            kind=pipe_schedule, virtual=max(int(pipe_virtual), 1),
+            stages=int(pipe_stages),
+            microbatches=int(pipe_microbatches) or int(pipe_stages),
+        )
+        # the table executor runs the whole loss inside its own
+        # partial-manual scan: the in-scan gather/grad/probe machinery
+        # of the composed step does not exist there (yet) — refuse each
+        # pair by name rather than silently dropping a slot
+        for other in (s for s in (gather, grad, probe) if s is not None):
+            raise ScheduleConflictError(
+                f"pipe slot ({pipe.describe()}) conflicts with the "
+                f"{other.describe()} slot: the table-driven pipeline "
+                f"computes its gradients explicitly inside the tick "
+                f"scan, which does not thread the in-scan "
+                f"release/gather/probe machinery — drop one of the "
+                f"two slots"
+            )
+        if not getattr(model, "supports_pipe_table", False):
+            raise ScheduleConflictError(
+                f"pipe slot ({pipe.describe()}): "
+                f"{type(model).__name__} does not support table-driven "
+                f"pipeline schedules (supports_pipe_table=False — e.g. "
+                f"the MoE aux loss would need to ride every F tick and "
+                f"replay in W's re-linearization); use "
+                f"pipeline_schedule='1f1b'"
+            )
+        busy = [ax for ax in busy_axes
+                if ax is not None and ax != "pipe"]
+        if busy:
+            raise ScheduleConflictError(
+                f"pipe slot ({pipe.describe()}): the table executor is "
+                f"manual over the pipe axis only (data stays GSPMD) — "
+                f"it does not compose with active axes {busy}; use "
+                f"pipeline_schedule='1f1b' for seq parallelism"
+            )
+        if n_layer and n_layer % (pipe.stages * pipe.virtual):
+            raise ScheduleConflictError(
+                f"pipe slot ({pipe.describe()}): n_layer={n_layer} not "
+                f"divisible by stages*virtual="
+                f"{pipe.stages}*{pipe.virtual}"
+            )
+        from .pipe_schedule import build_pipe_program
+        try:
+            prog = build_pipe_program(
+                pipe.stages, pipe.virtual, pipe.microbatches,
+                split_w=(pipe.kind == "zbub"),
+                n_layer=n_layer or None,
+            )
+        except ValueError as e:
+            raise ScheduleConflictError(
+                f"pipe slot ({pipe.describe()}): {e}"
+            ) from e
+        return Schedule(pipe=pipe, pipe_program=prog, lowering="pipe")
+
     if gather is None and grad is None and probe is None:
         return Schedule(lowering="plain")
 
@@ -1019,13 +1123,6 @@ def build_schedule(
                 f"{gather.groups}) is only emitted by the single-slot "
                 f"prefetch lowering; it conflicts with "
                 f"{'+'.join(s.describe() for s in slots if s is not gather)}"
-            )
-        if grad is not None and grad.groups:
-            raise ScheduleConflictError(
-                f"grad slot: the 2-hop grad schedule (grad_comm_groups="
-                f"{grad.groups}) is only emitted by the single-slot "
-                f"monolithic lowering; it conflicts with "
-                f"{'+'.join(s.describe() for s in slots if s is not grad)}"
             )
         if grad is not None and n_layer and n_layer % grad.buckets:
             raise ValueError(
@@ -1629,6 +1726,11 @@ def composed_step(eng, state, idx, targets, rng, scale):
     lb = L // kb
     mode = grad.mode if grad is not None else "fp32"
     blk = grad.block if grad is not None else DEFAULT_BLOCK
+    # 2-hop hierarchical release: every quantized sync below (bucket,
+    # quantized tail, stage<3 tail) passes the SAME inner split down to
+    # quantized_grad_sync — the composed counterpart of the monolithic
+    # lowering's grad_comm_groups schedule
+    inner = grad.groups if grad is not None else None
     lay = sched.layout
     bpad = lay["bucket_pad"] if lay is not None else 0
     tail_names = sorted(nm for nm in state.params
@@ -1998,6 +2100,7 @@ def composed_step(eng, state, idx, targets, rng, scale):
                             red, new_res_b = quantized_grad_sync(
                                 gf, res_b if "res" in ops_ else None,
                                 ax, n, mode, block=blk, rng=key,
+                                inner=inner,
                             )
                             if new_res_b is None:
                                 new_res_b = jnp.zeros((0,), jnp.float32)
@@ -2109,7 +2212,7 @@ def composed_step(eng, state, idx, targets, rng, scale):
                         tex_["rng"], jnp.uint32)
                 red, new_tr = quantized_grad_sync(
                     g32, tex_.get("res"), ax, n, tmode, block=blk,
-                    rng=key,
+                    rng=key, inner=inner,
                 )
                 # mean full grads -> each rank's canonical 1/n shard
                 # for the leaves the ZeRO layout shards; replicated
@@ -2213,6 +2316,7 @@ def composed_step(eng, state, idx, targets, rng, scale):
             else:
                 tail_red, new_tres = quantized_grad_sync(
                     tail, tres, ax, n, mode, block=blk, rng=tkey,
+                    inner=inner,
                 )
             g_tail = {nm: tail_red[nm].astype(g_tail[nm].dtype)
                       for nm in tail_names}

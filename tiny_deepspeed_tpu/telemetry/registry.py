@@ -555,6 +555,16 @@ class Telemetry:
                                 led, sched.hpz_geom[1]
                             ),
                         )
+        # table-driven pipeline schedules (parallel/pipe_schedule.py):
+        # the compiled (tick, stage) program's occupancy — bubble_frac is
+        # the number the interleaved/zero-bubble lowerings exist to
+        # shrink below 1F1B's (S-1)/(M+S-1)
+        prog = getattr(
+            getattr(engine, "_schedule", None), "pipe_program", None
+        )
+        if prog is not None:
+            self.gauge("bubble_frac", float(prog.bubble_frac))
+            self.gauge("pipe_ticks", int(prog.n_ticks))
         modeled = float(model_rep.get("total_bytes_per_step", 0.0))
         if modeled > 0:
             out["comm_delta"] = round(
@@ -667,6 +677,34 @@ class Telemetry:
             self._cost_loops or [],
             float(self._comm["hlo_cost"]["total_flops"]),
         )
+
+    def pipe_trace(self, engine=None) -> Optional[dict]:
+        """The attached engine's compiled pipeline tick program
+        (parallel/pipe_schedule.PipeProgram) serialized for the trace
+        record's `pipe` field — stage-major op/vchunk/mb rows plus the
+        occupancy numbers, all plain JSON types so trace_view.py's
+        jax-free path-import can render the per-stage pipeline track.
+        None when no table schedule compiled (gpipe/1f1b/unpipelined)."""
+        engine = engine or self._engine
+        prog = getattr(
+            getattr(engine, "_schedule", None), "pipe_program", None
+        )
+        if prog is None:
+            return None
+        return {
+            "describe": prog.describe(),
+            "stages": int(prog.stages),
+            "virtual": int(prog.virtual),
+            "microbatches": int(prog.microbatches),
+            "split_w": bool(prog.split_w),
+            "n_ticks": int(prog.n_ticks),
+            "bubble_frac": round(float(prog.bubble_frac), 6),
+            "busy": [int(b) for b in prog.busy],
+            # (T, S) arrays transposed stage-major: row s = stage s's ticks
+            "op": prog.op.T.tolist(),
+            "vchunk": prog.vchunk.T.tolist(),
+            "mb": prog.mb.T.tolist(),
+        }
 
     # -- sinks --------------------------------------------------------------
 

@@ -124,7 +124,17 @@ _NUM = (int, float)
 #      grad_comm_tail/gather_groups/hpz/hpz_comm) — all emitted only
 #      by engines running the new knobs, so older files stay
 #      byte-compatible with v12 readers
-SCHEMA_VERSION = 13
+#  14: + the table-driven pipeline schedules (parallel/pipe_schedule.py):
+#      engines running pipeline_schedule='interleaved:V'/'zbub[:V]'
+#      additionally gauge bubble_frac (idle-tick fraction of the
+#      compiled (tick, stage) program — the schedule-occupancy number
+#      the interleaved/zero-bubble lowerings exist to shrink below
+#      1F1B's (S-1)/(M+S-1)) and pipe_ticks (the program length), and
+#      trace records may carry `pipe` (the per-stage tick occupancy
+#      rows rendered as the trace viewer's pipeline track) — all
+#      emitted only when a pipe program compiled, so older files stay
+#      byte-compatible with v13 readers
+SCHEMA_VERSION = 14
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -189,6 +199,10 @@ META_FIELDS: Dict[str, tuple] = {
     # trace record: per-layer FLOP-sized compute spans from the HLO cost
     # ledger's loop attribution (utils/hlo_cost; telemetry/trace.py)
     "compute_spans": list,
+    # trace record: the compiled pipeline tick program's per-stage
+    # occupancy rows (telemetry/trace.py::pipe_trace; rendered by
+    # trace_view.py as one timeline row per pipeline stage)
+    "pipe": dict,
     # flight record (telemetry/flight.py)
     "reason": str,
     "steps": list,
@@ -608,4 +622,12 @@ GAUGES: Dict[str, str] = {
                                    "occasional failures are normal — "
                                    "a climb means a rotten candidate "
                                    "list)",
+    "bubble_frac": "idle-tick fraction of the compiled (tick, stage) "
+                   "pipeline program (parallel/pipe_schedule.py: "
+                   "1 - busy_ticks / (n_ticks * stages)) — the "
+                   "schedule-occupancy number the interleaved / "
+                   "zero-bubble lowerings exist to shrink below 1F1B's "
+                   "(S-1)/(M+S-1)",
+    "pipe_ticks": "length of the compiled pipeline tick program (the "
+                  "bubble_frac denominator's tick axis)",
 }

@@ -22,6 +22,12 @@ arXiv:2412.14374).  Two span sources join into one timeline:
     widths are schematic (every span carries "schematic": true), their
     byte/count annotations are exact ledger values.
 
+Pipelined runs add a third source: the compiled tick program
+(parallel/pipe_schedule.py) persisted as the trace record's `pipe` dict
+lays out one timeline row PER PIPELINE STAGE — each tick an equal slice
+of the step's compute window, labeled {F/B/W, chunk, microbatch}, idle
+ticks left as gaps so the schedule bubble is visible whitespace.
+
 `scripts/trace_view.py` turns a run's metrics JSONL into Chrome-trace
 JSON (chrome://tracing, https://ui.perfetto.dev) using this module; the
 `trace` meta record (schema.py) persists the span template so the viewer
@@ -233,6 +239,46 @@ _TID_STEP = 0        # whole-step spans
 _TID_SEG = 1         # wall segments
 _TID_COMM = 2        # schematic collective spans
 _TID_FLOPS = 3       # schematic FLOP-sized compute spans (cost ledger)
+_TID_PIPE0 = 4       # pipeline stage s -> tid _TID_PIPE0 + s (tick table)
+
+# the pipe track's op code -> glyph map (parallel/pipe_schedule.OP_*;
+# inlined here so the standalone path-import stays jax-free)
+_PIPE_OPS = {0: "idle", 1: "F", 2: "B", 3: "W"}
+
+
+def pipe_span_rows(pipe: Dict[str, object]) -> List[List[dict]]:
+    """Per-stage span rows from a trace record's `pipe` dict (the
+    compiled tick program serialized by Telemetry.pipe_trace): one list
+    per stage, one span per NON-IDLE tick:
+
+      {"name": "F c3 m1", "op", "tick", "vchunk", "mb",
+       "ticks": T, "schematic": True}
+
+    Tick positions are schedule coordinates — the viewer scales them
+    into each step's compute window (every tick the same width), so the
+    layout is schematic like the wire/FLOP spans; the op/chunk/
+    microbatch labels are the exact compiled program."""
+    ops = pipe.get("op") or []
+    vchunk = pipe.get("vchunk") or []
+    mb = pipe.get("mb") or []
+    n_ticks = int(pipe.get("n_ticks") or (len(ops[0]) if ops else 0))
+    rows: List[List[dict]] = []
+    for st, row in enumerate(ops):
+        spans: List[dict] = []
+        for t, op in enumerate(row):
+            op = int(op)
+            if op == 0:
+                continue
+            c = int(vchunk[st][t]) if vchunk else -1
+            j = int(mb[st][t]) if mb else -1
+            spans.append({
+                "name": f"{_PIPE_OPS.get(op, '?')} c{c} m{j}",
+                "op": _PIPE_OPS.get(op, "?"), "tick": t,
+                "vchunk": c, "mb": j, "ticks": n_ticks,
+                "schematic": True,
+            })
+        rows.append(spans)
+    return rows
 
 
 def chrome_trace(metas: List[dict], steps: List[dict],
@@ -244,10 +290,12 @@ def chrome_trace(metas: List[dict], steps: List[dict],
     schematic).  Timestamps are microseconds from the first record."""
     spans = None
     cspans = None
+    pipe = None
     tr = _find(metas, "trace")
     if tr is not None:
         spans = tr.get("spans")
         cspans = tr.get("compute_spans")
+        pipe = tr.get("pipe")
     run = _find(metas, "run_meta") or {}
     if spans is None:
         measured = run.get("comm_measured")
@@ -257,6 +305,8 @@ def chrome_trace(metas: List[dict], steps: List[dict],
     total_wire = sum(s.get("wire_bytes", 0.0) for s in spans) or 1.0
     cspans = cspans or []
     total_flops = sum(s.get("flops", 0.0) for s in cspans) or 1.0
+    pipe_rows = pipe_span_rows(pipe) if pipe else []
+    pipe_ticks = int(pipe.get("n_ticks") or 1) if pipe else 1
 
     events: List[dict] = [
         {"ph": "M", "pid": 0, "name": "process_name",
@@ -273,6 +323,12 @@ def chrome_trace(metas: List[dict], steps: List[dict],
             {"ph": "M", "pid": 0, "tid": _TID_FLOPS,
              "name": "thread_name",
              "args": {"name": "compute (schematic, HLO cost ledger)"}})
+    for st in range(len(pipe_rows)):
+        events.append(
+            {"ph": "M", "pid": 0, "tid": _TID_PIPE0 + st,
+             "name": "thread_name",
+             "args": {"name": f"pipe stage {st} "
+                              f"({pipe.get('describe', 'tick table')})"}})
 
     timed = [r for r in steps if isinstance(r.get("ts"), (int, float))
              and isinstance(r.get("step_s"), (int, float))]
@@ -344,6 +400,22 @@ def chrome_trace(metas: List[dict], steps: List[dict],
                 ),
             })
             fcursor += fdur
+        # the pipeline tick table: one row per stage, each tick an equal
+        # slice of the compute window (schedule coordinates — schematic
+        # widths, exact op/chunk/microbatch labels); idle ticks render as
+        # gaps, so the bubble is VISIBLE as whitespace on the track
+        tick_dur = cdur / pipe_ticks
+        for st, row in enumerate(pipe_rows):
+            for sp in row:
+                events.append({
+                    "ph": "X", "pid": 0, "tid": _TID_PIPE0 + st,
+                    "name": sp["name"],
+                    "ts": us(c0 + sp["tick"] * tick_dur),
+                    "dur": us(tick_dur),
+                    "args": _json_safe(
+                        {k: v for k, v in sp.items() if k != "name"}
+                    ),
+                })
 
     flight = _find(metas, "flight")
     if flight is not None:
@@ -361,6 +433,11 @@ def chrome_trace(metas: List[dict], steps: List[dict],
             "source": source,
             "schematic_collectives": bool(spans),
             "schematic_compute": bool(cspans),
+            "schematic_pipeline": bool(pipe_rows),
+            "pipeline_bubble_frac": (
+                round(float(pipe.get("bubble_frac", 0.0)), 6)
+                if pipe else 0.0
+            ),
             "spans_total_wire_bytes": round(float(sum(
                 s.get("wire_bytes", 0.0) for s in spans
             )), 3),
