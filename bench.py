@@ -1075,6 +1075,7 @@ def run_serve(model_name: str, b=None, t=None):
     from tiny_deepspeed_tpu.serving.driver import (
         Arrival, poisson_trace, run_trace,
     )
+    from tiny_deepspeed_tpu.telemetry.slo import SLOObjective, SLOTracker
 
     del b, t
     n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", "12"))
@@ -1114,7 +1115,13 @@ def run_serve(model_name: str, b=None, t=None):
         n_req, rate_rps=rate, prompt_lens=prompt_lens,
         max_new_tokens=max_new, vocab_size=cfg.vocab_size, seed=0,
     )
-    res = run_trace(eng, trace, realtime=rate is not None)
+    # SLO attainment rides the record (extra.slo.attainment): with a
+    # latency objective matched to the closed-loop run it is a
+    # higher-is-better service-quality fingerprint perf_diff.py's
+    # sentinel watches — tokens/s can hold while attainment rots (e.g.
+    # a scheduler change that trades tail latency for batch occupancy)
+    slo = SLOTracker(default=SLOObjective(target=0.99, latency_s=120.0))
+    res = run_trace(eng, trace, realtime=rate is not None, slo=slo)
     return {
         "metric": f"{model_name}_serve_tokens_per_sec",
         "value": res["tokens_per_s"],
@@ -1139,6 +1146,10 @@ def run_serve(model_name: str, b=None, t=None):
             # resolved kernel arms: the record can never claim a
             # kernel choice that fell back on this backend
             "kernels": _kernel_stamp(serve_cfg.paged_kernel),
+            # service-quality fingerprint (schema v15 SLO accounting):
+            # fraction of requests that met the default objective
+            "slo": {"attainment": res["slo"]["attainment"],
+                    "alerts": len(res["slo"]["alerts"])},
         },
     }
 

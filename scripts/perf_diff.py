@@ -24,6 +24,10 @@ nonzero for CI when something regressed:
     reachable, a >2% jump in HLO-counted FLOPs for the same fingerprint
     is printed as a NOTE: the program changed, whether or not the clock
     noticed yet.
+  * **SLO-attainment regression** — serve records stamp
+    `extra.slo.attainment` (higher is better); a drop vs the best prior
+    round beyond the noise floor flags a service regression that raw
+    tokens/s can mask (tail latency traded for batch occupancy).
 
 Records are usable only when fresh: value > 0 and not replayed from the
 last-good cache (`extra.cached_result` — BENCH_r04/r05 replay a round-3
@@ -74,6 +78,16 @@ _WIRE_KEYS = (
     # arms) — a bubble creeping back up is a schedule regression the
     # clock on a CPU mesh never notices
     ("sched.bubble_frac", "pipeline bubble frac"),
+)
+
+# per-fingerprint HIGHER-is-better extras (the wire keys above are all
+# lower-is-better): serve records stamp extra.slo.attainment (fraction
+# of requests meeting the default SLO objective, telemetry/slo.py) —
+# a drop vs the best prior round beyond the noise floor is a SERVICE
+# regression even when tokens/s held (tail latency traded away for
+# throughput).  Rounds that predate the stamp simply don't participate.
+_ATTAIN_KEYS = (
+    ("slo.attainment", "SLO attainment"),
 )
 
 
@@ -266,6 +280,25 @@ def diff_rounds(rounds: List[Tuple[str, List[dict]]],
                     f"{w_new:,.0f} B vs best-of-{len(w_prior)} "
                     f"{best_w:,.0f} B ({rel:+.1%} > {noise_floor:.1%}) "
                     f"— the compiled step moves more bytes"
+                )
+        # service regression: SLO attainment (higher is better) —
+        # newest vs the best (highest) prior value carrying the field
+        for dotted, label in _ATTAIN_KEYS:
+            a_new = _wire_of(newest, dotted)
+            a_prior = [a for a in (_wire_of(r, dotted) for _, r in prior)
+                       if a is not None]
+            if a_new is None or not a_prior:
+                continue
+            best_a = max(a_prior)
+            if best_a <= 0.0:
+                continue
+            rel = (best_a - a_new) / best_a
+            if rel > noise_floor:
+                regressions.append(
+                    f"REGRESSION {fp} [{newest_name}]: {label} "
+                    f"{a_new:.3f} vs best-of-{len(a_prior)} "
+                    f"{best_a:.3f} ({-rel:+.1%} > {noise_floor:.1%}) "
+                    f"— fewer requests met their SLO objective"
                 )
         # program growth: HLO-counted FLOPs for the same fingerprint
         f_old = _sidecar_flops(prior[-1][1],

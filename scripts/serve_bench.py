@@ -138,6 +138,19 @@ def main(argv=None) -> int:
                    help="override the ARRIVAL mix only: comma weights "
                         "aligned with --tenants order (default: the "
                         "tenants' scheduling weights)")
+    p.add_argument("--slo", default=None, metavar="SPEC",
+                   help="SLO objective 'target=0.99,ttft=0.5,latency=5' "
+                        "(telemetry/slo.py grammar; keys optional): "
+                        "terminal requests feed multi-window error-"
+                        "budget burn accounting, the summary gains the "
+                        "budget snapshot, and a fast-burn alert flushes "
+                        "the flight recorder")
+    p.add_argument("--live-port", type=int, default=None, metavar="PORT",
+                   help="start the live observability exporter on this "
+                        "port (0 = OS-assigned; printed to stderr): "
+                        "/metrics Prometheus text, /healthz per-replica "
+                        "state, /slo budget JSON — host-side only, "
+                        "scrape while the bench runs")
     p.add_argument("--serial", action="store_true",
                    help="also run the one-at-a-time generate() baseline "
                         "on the same trace and report the ratio")
@@ -289,8 +302,37 @@ def main(argv=None) -> int:
         p.error("--chaos journal_kill@N needs --journal PATH (the kill "
                 "fires inside the journal's commit, and recovery "
                 "replays it); fleet mode auto-assigns journals")
+    slo_obj = None
+    if args.slo:
+        from tiny_deepspeed_tpu.telemetry.slo import SLOObjective
+        try:
+            slo_obj = SLOObjective.parse(args.slo)
+        except ValueError as e:
+            p.error(f"--slo: {e}")
 
     logger = make_logger(jsonl_path)
+
+    # the live plane attaches to the MEASURED pass only (warm requests
+    # pollute neither the aggregator nor the SLO budget, same contract
+    # as telemetry/logger); the exporter is a loopback daemon thread —
+    # strictly host-side, so serving HLO and tick cadence are untouched
+    slo_tracker = None
+    live_agg = None
+    exporter = None
+    if args.slo or args.live_port is not None:
+        from tiny_deepspeed_tpu.telemetry.slo import SLOTracker
+        from tiny_deepspeed_tpu.telemetry.slo import SLOObjective as _Obj
+        slo_tracker = SLOTracker(default=slo_obj or _Obj())
+    if args.live_port is not None:
+        from tiny_deepspeed_tpu.telemetry.live import (
+            LiveAggregator, LiveExporter,
+        )
+        live_agg = LiveAggregator()
+        exporter = LiveExporter(live_agg, slo=slo_tracker,
+                                port=args.live_port)
+        port = exporter.start()
+        print(f"live exporter -> http://127.0.0.1:{port}/metrics "
+              "(also /healthz, /slo)", file=sys.stderr)
 
     # warm run on the SAME engine (each engine owns fresh jit closures,
     # so warming a throwaway one buys nothing): one request per DISTINCT
@@ -386,9 +428,15 @@ def main(argv=None) -> int:
         return e
 
     eng = build_target(tel, logger)
-    res = run_trace(eng, trace, realtime=realtime)
+    res = run_trace(eng, trace, realtime=realtime,
+                    slo=slo_tracker, live=live_agg)
     res.pop("outputs")
     res.pop("requests")
+    if slo_tracker is not None and logger is not None:
+        # final budget snapshot as an `slo` record: the engine only
+        # emits one when an alert fires, but serve_report's "SLO
+        # budgets" section needs the end-of-run state on clean runs too
+        slo_tracker.record(logger)
 
     summary = {
         "model": args.model,
@@ -427,6 +475,11 @@ def main(argv=None) -> int:
         summary["prefix_cache"] = res["prefix_cache"]
     if "tenants" in res:
         summary["tenants"] = res["tenants"]
+    if "slo" in res:
+        # the budget snapshot rides the machine-readable line, so
+        # bench.py's BENCH_SERVE extra carries slo.attainment — the
+        # higher-is-better key perf_diff.py's sentinel watches
+        summary["slo"] = res["slo"]
 
     if args.chaos:
         # goodput under faults, A/B on the SAME trace: the clean pass
@@ -580,6 +633,18 @@ def main(argv=None) -> int:
         print(f"serial generate() baseline: "
               f"{summary['serial_tokens_per_s']} tok/s -> "
               f"{summary['vs_serial']}x")
+    if "slo" in summary:
+        sl = summary["slo"]
+        print(f"slo: attainment {sl['attainment']}, "
+              f"{len(sl['alerts'])} alert(s) "
+              f"(windows {sl['windows_s']}s)")
+    if exporter is not None:
+        agg_snap = live_agg.snapshot()
+        print(f"live exporter served {live_agg.scrapes} scrape(s), "
+              f"aggregated {sum(agg_snap['ticks'].values())} tick "
+              f"snapshot(s) across {len(agg_snap['ticks'])} replica "
+              "stream(s)", file=sys.stderr)
+        exporter.stop()
     print(json.dumps(summary))
 
     if logger is not None:

@@ -39,6 +39,16 @@ operational questions the percentile headline cannot:
     aliased, prefill tokens avoided / hit rate from the request
     records, and the refcount-measured pool bytes saved from the
     telemetry summary gauges.
+  * SLO budgets section (schema v15, SLO-configured runs): per-tenant
+    attainment, error-budget spend and multi-window burn rates from the
+    engine's `slo` records, plus every burn alert fired over the run —
+    the tail table above names the component, this section names the
+    tenant whose budget paid for it.  Cross-engine tails split too:
+    `comp_migrate_s` is the prefill->decode handoff wait, a re-prefill
+    on the decode engine lands in prefill/restart-overhead.
+  * fleet runs additionally get a per-replica gauge table from the
+    `name{replica=N}` labeled gauge keys (schema v15) — parallel
+    replicas no longer overwrite each other's last-tick state.
 
 Exit codes: 0 ok; 1 parse errors in the JSONL (partial report rendered);
 2 missing/empty input or no serving records at all.
@@ -54,13 +64,18 @@ from typing import Dict, List
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# latency-component record fields -> dashboard labels, in partition order
+# latency-component record fields -> dashboard labels, in partition
+# order; comp_migrate_s (schema v15) appears only on disagg-migrated
+# requests — a cross-engine tail names migration-wait when the request
+# queued at the prefill->decode handoff, prefill when it re-prefilled
+# on the decode engine after a preemption/restart there
 COMPONENTS = (
     ("comp_queue_s", "queue-wait"),
     ("comp_prefill_s", "prefill"),
     ("comp_decode_s", "decode-active"),
     ("comp_preempt_s", "preempted-wait"),
     ("comp_restart_s", "restart-overhead"),
+    ("comp_migrate_s", "migration-wait"),
 )
 
 
@@ -77,7 +92,21 @@ def _load_trace_module():
     return mod
 
 
+def _load_live_module():
+    """telemetry/live.py by file path (same trick): pure stdlib, and
+    the dashboard needs its parse_gauge_key to split label-qualified
+    gauge keys (schema v15) back into (base, {replica: N})."""
+    spec = importlib.util.spec_from_file_location(
+        "tiny_deepspeed_tpu_live_for_serve_report",
+        os.path.join(_REPO, "tiny_deepspeed_tpu", "telemetry", "live.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 trace = _load_trace_module()
+live = _load_live_module()
 # ONE quantile implementation for the jax-free scripts (the loaded
 # trace module's copy) — report_run.py's percentiles come from the same
 # formula via utils/profiling._quantile
@@ -374,6 +403,32 @@ def render_serve_report(metas: List[dict], source: str = "") -> str:
                     f"{sum(r.get('new_tokens', 0) for r in rs)} | "
                     f"{_ms(_quantile(lats, 0.99)) if lats else '-'} |")
             out.append("")
+        # per-replica labeled gauges (schema v15): each replica writes
+        # `name{replica=N}` into the SHARED registry, so the fleet's
+        # last telemetry_summary carries every replica's last-tick
+        # state side by side instead of last-writer-wins
+        rep_gauges: Dict[str, Dict[str, float]] = {}
+        for key, v in gauges.items():
+            base, labels = live.parse_gauge_key(key)
+            if "replica" in labels and isinstance(v, (int, float)):
+                rep_gauges.setdefault(
+                    labels["replica"], {})[base] = float(v)
+        if rep_gauges:
+            cols = (("serve_queue_depth", "queue"),
+                    ("serve_batch_occupancy", "occupancy"),
+                    ("serve_pool_utilization", "pool util"),
+                    ("serve_restarts", "restarts"),
+                    ("serve_quarantined", "quarantined"))
+            out.append("Per-replica gauges at last tick:\n")
+            out.append("| replica | " + " | ".join(
+                label for _, label in cols) + " |")
+            out.append("|" + "---|" * (len(cols) + 1))
+            for rid in sorted(rep_gauges):
+                g = rep_gauges[rid]
+                out.append(f"| {rid} | " + " | ".join(
+                    (f"{g[k]:g}" if k in g else "-")
+                    for k, _ in cols) + " |")
+            out.append("")
         for f in failovers:
             out.append(f"- failover at tick {f.get('at_step', '?')}: "
                        f"{f.get('action', '?')}")
@@ -410,6 +465,59 @@ def render_serve_report(metas: List[dict], source: str = "") -> str:
         out.append("\n```")
         out.extend(_histogram_ascii(slo))
         out.append("```\n")
+
+    # -- SLO error budgets (schema v15 `slo` records) -----------------------
+    slo_recs = [m for m in metas if m.get("kind") == "slo"]
+    if slo_recs:
+        last = slo_recs[-1]
+        ws = (last.get("windows") or {}).get("s") or []
+        out.append("## SLO budgets\n")
+        att = last.get("attainment")
+        out.append(
+            f"- attainment {att:.2%}" if isinstance(att, (int, float))
+            else "- attainment -")
+        out[-1] += (f" across all tenants, burn windows {ws}s "
+                    f"({len(slo_recs)} snapshot(s) in the run)")
+        tenants = last.get("tenants") or {}
+        if tenants:
+            out.append("\n| tenant | target | requests | good | "
+                       "attainment | budget spent | burn rates |")
+            out.append("|---|---|---|---|---|---|---|")
+            for name in sorted(tenants):
+                td = tenants[name] or {}
+                obj = td.get("objective") or {}
+                burn = td.get("burn") or {}
+                burn_s = ", ".join(
+                    f"{k} {float(v):.1f}x"
+                    for k, v in sorted(burn.items())) or "-"
+                spent = td.get("budget_spent_frac")
+                out.append(
+                    f"| {name} | {obj.get('target', '-')} | "
+                    f"{td.get('requests', 0)} | {td.get('good', 0)} | "
+                    f"{float(td.get('attainment', 1.0)):.2%} | "
+                    + (f"{float(spent):.0%}"
+                       if isinstance(spent, (int, float)) else "-")
+                    + f" | {burn_s} |")
+            out.append("")
+        # every alert over the run, not just the ones still burning at
+        # the last snapshot — this is the postmortem ledger
+        seen_alerts = []
+        for rec in slo_recs:
+            for a in rec.get("alerts") or []:
+                key = (a.get("tenant"), a.get("kind"), a.get("t"))
+                if key not in {(x.get("tenant"), x.get("kind"),
+                                x.get("t")) for x in seen_alerts}:
+                    seen_alerts.append(a)
+        for a in seen_alerts:
+            out.append(
+                f"- alert: **{a.get('kind', '?')}** for tenant "
+                f"`{a.get('tenant', '?')}` — burn "
+                f"{float(a.get('burn', 0.0)):.1f}x over "
+                f"{a.get('window_s', '?')}s (threshold "
+                f"{a.get('threshold', '?')}x); fast-burn alerts also "
+                "flushed the flight ring (`slo_fast_burn` below)")
+        if seen_alerts:
+            out.append("")
 
     # -- shed audit ---------------------------------------------------------
     sheds: Dict[str, int] = {}
@@ -506,7 +614,8 @@ def render_serve_report(metas: List[dict], source: str = "") -> str:
         out.append("")
 
     flights = [m for m in metas if m.get("kind") == "flight"
-               and str(m.get("reason", "")).startswith("serve_")]
+               and str(m.get("reason", "")).startswith(
+                   ("serve_", "slo_"))]
     if flights:
         out.append("## Flight records\n")
         for fl in flights:

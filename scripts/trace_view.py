@@ -21,6 +21,18 @@ request wait windows, and one track per decode slot with each request's
 active windows — preemptions, quarantines, and watchdog warm restarts
 visible as span boundaries and instant markers.
 
+FLEET serving files (records carrying `replica_id`) lay out one process
+per replica, each with the full tick/queue/slot track set; a request
+that crossed engines (disagg prefill->decode migration, failover) gets
+its windows on every replica it touched, correlated by the `trace_id`
+in their span args.  Ambiguous coordinates in such a shared stream
+resolve by ONE rule (telemetry/trace.py::serving_chrome_trace): records
+carrying an explicit key — replica_id on ticks/flights, per-event
+replica stamps on request lifecycles — route by it; records without one
+anchor by file order (last matching record written before, else first
+after), which is how flight flushes land on the right engine lifetime
+when two lifetimes' tick counters both start at 0.
+
 Span assembly lives in `tiny_deepspeed_tpu/telemetry/trace.py`; the
 input comes from `examples/* --telemetry --metrics RUN.jsonl` (which
 also writes the `trace` span-template record), `bench.py`'s telemetry
@@ -105,8 +117,11 @@ def main(argv=None) -> int:
     out = args.out or (os.path.splitext(args.jsonl)[0] + ".trace.json")
     with open(out, "w") as f:
         json.dump(doc, f)
-    print(f"wrote {out}: {n_spans} spans over {n_laid} {laid_out} — "
-          "open in chrome://tracing or https://ui.perfetto.dev")
+    reps = doc.get("otherData", {}).get("replicas") or []
+    fleet = (f" across {len(reps)} replicas" if len(reps) > 1 else "")
+    print(f"wrote {out}: {n_spans} spans over {n_laid} {laid_out}"
+          f"{fleet} — open in chrome://tracing or "
+          "https://ui.perfetto.dev")
     return 1 if errs else 0
 
 

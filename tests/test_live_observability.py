@@ -1,0 +1,680 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Live fleet observability plane (ISSUE 20): streaming metric
+aggregation, the /metrics exporter, cross-engine request tracing, and
+SLO error-budget accounting.
+
+Acceptance pins:
+  * a chaos fleet run (disagg handoff + engine_kill failover) yields a
+    Chrome trace with ONE request's spans on TWO replica processes,
+    correlated by the `trace_id` in their span args — and /metrics
+    scraped MID-RUN parses with per-replica gauge labels;
+  * the exporter is host-side only: aggregating and rendering a
+    poisoned registry snapshot must never call `__array__` (the PR-10
+    flight-pin style, applied to the scrape path);
+  * Prometheus text round-trips through the minimal parser (types,
+    labels, summary quantiles);
+  * `slo` records validate under schema v15, burn alerts fire on the
+    TRANSITION into burning, and a fast burn arms the flight ring;
+  * flight flushes in a SHARED fleet stream anchor by their replica_id
+    key — file order is only the fallback for records without one
+    (the ONE documented rule, trace.py::serving_chrome_trace).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tiny_deepspeed_tpu import GPTConfig, GPT2Model
+
+CFG = dict(block_size=64, vocab_size=128, n_layer=2, n_head=2,
+           n_embd=32, compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPT2Model(GPTConfig(**CFG))
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.PRNGKey(0))
+
+
+def _prompt(seed, n, vocab=128):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, vocab),
+        np.int32,
+    ).tolist()
+
+
+def _serve_config(**kw):
+    from tiny_deepspeed_tpu.serving import ServeConfig
+    kw.setdefault("max_active", 2)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("block_tokens", 8)
+    kw.setdefault("max_seq_tokens", 40)
+    return ServeConfig(**kw)
+
+
+def _get(url):
+    return urllib.request.urlopen(url, timeout=10).read().decode()
+
+
+# ---------------------------------------------------------------------------
+# gauge labels (satellite a)
+# ---------------------------------------------------------------------------
+
+class TestGaugeLabels:
+    def test_gauge_key_roundtrip(self):
+        from tiny_deepspeed_tpu.telemetry.live import (
+            gauge_key, parse_gauge_key,
+        )
+        k = gauge_key("serve_queue_depth", replica=0)
+        assert k == "serve_queue_depth{replica=0}"
+        assert parse_gauge_key(k) == ("serve_queue_depth",
+                                      {"replica": "0"})
+        # bare keys parse to themselves — pre-v15 files stay readable
+        assert parse_gauge_key("serve_queue_depth") == (
+            "serve_queue_depth", {})
+        # labels sort, so the key is canonical regardless of kw order
+        assert gauge_key("g", b="2", a="1") == gauge_key("g", a="1", b="2")
+
+    def test_registry_labels_qualify_the_key(self):
+        """Two replicas writing the same gauge through a SHARED registry
+        land on distinct keys — the PR-16 last-writer-wins wart — while
+        replica=None (single-engine) keeps the historical bare key."""
+        from tiny_deepspeed_tpu.telemetry import Telemetry
+        tel = Telemetry()
+        tel.gauge("serve_queue_depth", 3.0, replica=0)
+        tel.gauge("serve_queue_depth", 5.0, replica=1)
+        tel.gauge("serve_queue_depth", 7.0)
+        g = tel.gauges
+        assert g["serve_queue_depth{replica=0}"] == 3.0
+        assert g["serve_queue_depth{replica=1}"] == 5.0
+        assert g["serve_queue_depth"] == 7.0
+        # the labeled read returns the labeled value
+        assert tel.gauge("serve_queue_depth", replica=1) == 5.0
+
+    def test_fleet_run_emits_per_replica_gauges(self, model, params,
+                                                tmp_path):
+        """End-to-end: replica-id'd engines sharing one registry leave
+        BOTH replicas' last-tick state in the summary gauges."""
+        from tiny_deepspeed_tpu.fleet import FleetRouter
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.telemetry import Telemetry
+        tel = Telemetry()
+        engines = [
+            ServingEngine(model, params, _serve_config(),
+                          replica_id=i, telemetry=tel)
+            for i in range(2)
+        ]
+        router = FleetRouter(engines, telemetry=tel)
+        reqs = [router.submit(_prompt(s, 7), 6) for s in (1, 2, 3, 4)]
+        router.drain(max_ticks=300)
+        assert all(r.status == "ok" for r in reqs)
+        g = tel.gauges
+        for rid in (0, 1):
+            assert f"serve_queue_depth{{replica={rid}}}" in g, sorted(g)
+            assert g[f"serve_queue_depth{{replica={rid}}}"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# streaming aggregation + prometheus round-trip (tentpole 1, satellite c)
+# ---------------------------------------------------------------------------
+
+class TestLiveAggregator:
+    def test_counter_deltas_rates_and_reset(self):
+        from tiny_deepspeed_tpu.telemetry.live import LiveAggregator
+        agg = LiveAggregator()
+        for i, v in enumerate((10.0, 16.0, 25.0)):
+            agg.ingest({"counters": {"serve_tokens": v}}, t=float(i))
+        # rate = sum of deltas inside the window / elapsed in-window
+        assert agg.rate("serve_tokens", window_s=30.0, t=2.0) > 0.0
+        snap = agg.snapshot()
+        assert snap["counters"]["serve_tokens"] == 25.0
+        # a registry reset (fresh engine, counter back near zero) must
+        # restart the series, not record a huge negative delta
+        agg.ingest({"counters": {"serve_tokens": 2.0}}, t=3.0)
+        assert agg.snapshot()["counters"]["serve_tokens"] == 2.0
+        assert agg.rate("serve_tokens", window_s=30.0, t=3.0) > 0.0
+
+    def test_window_quantiles_per_labeled_gauge(self):
+        from tiny_deepspeed_tpu.telemetry.live import LiveAggregator
+        agg = LiveAggregator()
+        for i in range(10):
+            agg.ingest(
+                {"gauges": {"serve_queue_depth{replica=0}": float(i)}},
+                replica=0, t=float(i))
+        q = agg.window_quantiles("serve_queue_depth{replica=0}")
+        assert q["p50"] == pytest.approx(4.5)
+        assert q["p99"] >= q["p95"] >= q["p50"]
+        assert agg.snapshot()["ticks"] == {"0": 10}
+
+    def test_prometheus_text_roundtrip(self):
+        """Render -> parse is lossless for the shapes we emit: counter
+        totals, labeled gauges, summary quantiles + count/sum."""
+        from tiny_deepspeed_tpu.telemetry import live
+        agg = live.LiveAggregator()
+        agg.ingest({
+            "counters": {"serve_tokens": 42.0},
+            "gauges": {"serve_queue_depth{replica=0}": 3.0,
+                       "serve_queue_depth{replica=1}": 5.0,
+                       "serve_eviction_rate": 0.25},
+            "histograms": {"serve_token_latency": {
+                "count": 8, "mean": 0.5, "p50": 0.4, "p95": 0.9,
+                "p99": 1.0, "max": 1.2}},
+        }, replica=0, t=1.0)
+        text = agg.prometheus_text(t=1.0)
+        doc = live.parse_prometheus_text(text)
+        assert doc["types"]["serve_tokens_total"] == "counter"
+        assert doc["types"]["serve_queue_depth"] == "gauge"
+        assert doc["types"]["serve_token_latency"] == "summary"
+        samples = {(n, tuple(sorted(lb.items()))): v
+                   for n, lb, v in doc["samples"]}
+        assert samples[("serve_tokens_total", ())] == 42.0
+        assert samples[("serve_queue_depth",
+                        (("replica", "0"),))] == 3.0
+        assert samples[("serve_queue_depth",
+                        (("replica", "1"),))] == 5.0
+        assert samples[("serve_token_latency",
+                        (("quantile", "0.95"),))] == 0.9
+        assert samples[("serve_token_latency_count", ())] == 8.0
+        assert samples[("serve_token_latency_sum", ())] == \
+            pytest.approx(4.0)
+        assert samples[("live_ticks_total", (("replica", "0"),))] == 1.0
+
+    def test_parser_rejects_garbage(self):
+        from tiny_deepspeed_tpu.telemetry.live import (
+            parse_prometheus_text,
+        )
+        with pytest.raises(ValueError):
+            parse_prometheus_text("not a metric line at all!!!\n")
+
+    def test_aggregation_never_syncs_devices(self):
+        """The scrape path is host-side by CONSTRUCTION: a value that
+        would detonate on `__array__` (a stand-in for a device array)
+        must pass through ingest -> prometheus_text -> healthz via
+        plain float() only (the PR-10 flight-recorder pin, applied to
+        the exporter's hot path)."""
+        from tiny_deepspeed_tpu.telemetry.live import LiveAggregator
+
+        class _Unsyncable:
+            def __array__(self, *a, **k):
+                raise AssertionError(
+                    "live plane materialized a device array")
+
+            def __float__(self):
+                return 2.5
+
+        agg = LiveAggregator()
+        agg.ingest({
+            "counters": {"serve_tokens": _Unsyncable()},
+            "gauges": {"serve_queue_depth{replica=0}": _Unsyncable()},
+            "histograms": {"h": {"count": 1, "mean": _Unsyncable()}},
+        }, replica=0, t=1.0)
+        text = agg.prometheus_text(t=1.0)
+        assert "serve_tokens_total 2.5" in text
+        hz = agg.healthz(t=2.0)
+        assert hz["replicas"]["0"]["serve_queue_depth"] == 2.5
+
+
+class TestExporter:
+    def test_http_endpoints(self):
+        from tiny_deepspeed_tpu.telemetry import live, slo
+        agg = live.LiveAggregator()
+        agg.ingest({"counters": {"serve_tokens": 5.0},
+                    "gauges": {"serve_queue_depth{replica=0}": 1.0}},
+                   replica=0, t=1.0)
+        tracker = slo.SLOTracker()
+        tracker.observe(tenant=None, ok=True, latency_s=0.1, t=1.0)
+        with live.LiveExporter(agg, slo=tracker, port=0) as exp:
+            base = f"http://127.0.0.1:{exp.port}"
+            metrics = _get(base + "/metrics")
+            assert live.parse_prometheus_text(metrics)["samples"]
+            hz = json.loads(_get(base + "/healthz"))
+            assert hz["ok"] is True and "0" in hz["replicas"]
+            sl = json.loads(_get(base + "/slo"))
+            assert sl["attainment"] == 1.0
+            with pytest.raises(urllib.error.HTTPError):
+                _get(base + "/nope")
+        assert agg.scrapes >= 1
+
+
+# ---------------------------------------------------------------------------
+# SLO error budgets (tentpole 4)
+# ---------------------------------------------------------------------------
+
+class TestSLO:
+    def test_objective_grammar_and_goodness(self):
+        from tiny_deepspeed_tpu.telemetry.slo import SLOObjective
+        obj = SLOObjective.parse("target=0.95,ttft=0.5,latency=5")
+        assert (obj.target, obj.ttft_s, obj.latency_s) == (0.95, 0.5, 5.0)
+        assert obj.good(ok=True, ttft_s=0.4, latency_s=4.0)
+        assert not obj.good(ok=True, ttft_s=0.6, latency_s=4.0)
+        assert not obj.good(ok=True, ttft_s=0.4, latency_s=6.0)
+        assert not obj.good(ok=False, ttft_s=0.1, latency_s=0.2)
+        # an unset bound doesn't constrain; a missing measurement fails
+        # a set bound (can't prove it was met)
+        loose = SLOObjective.parse("target=0.9")
+        assert loose.good(ok=True, ttft_s=None, latency_s=None)
+        assert not obj.good(ok=True, ttft_s=None, latency_s=1.0)
+        with pytest.raises(ValueError, match="unknown SLO key"):
+            SLOObjective.parse("target=0.9,bogus=1")
+        with pytest.raises(ValueError, match="target"):
+            SLOObjective(target=1.0)
+
+    def test_burn_alert_fires_on_transition_only(self):
+        """burn = bad_frac / budget.  target=0.9 -> budget 0.1, so one
+        bad in two requests is burn 5.0; the fast rule (threshold 14)
+        needs > 1.4 bad fraction... use a tighter threshold to pin the
+        TRANSITION semantics: fire once entering, re-arm after clearing."""
+        from tiny_deepspeed_tpu.telemetry.slo import (
+            SLOObjective, SLOTracker,
+        )
+        fired = []
+        tr = SLOTracker(default=SLOObjective(target=0.9),
+                        windows_s=(10.0, 100.0), fast_burn=4.0,
+                        slow_burn=100.0, on_alert=fired.append)
+        tr.observe(tenant=None, ok=False, latency_s=1.0, t=1.0)
+        tr.observe(tenant=None, ok=False, latency_s=1.0, t=2.0)
+        # bad frac 1.0 / budget 0.1 = burn 10 >= 4: fires, once
+        alerts = tr.check(t=2.0)
+        assert len(alerts) == 1 and alerts[0]["kind"] == "fast_burn"
+        assert alerts[0]["burn"] == pytest.approx(10.0)
+        assert tr.check(t=2.5) == []  # still burning: no re-fire
+        assert fired == alerts
+        # window slides past the failures -> below threshold -> re-arm
+        assert tr.check(t=50.0) == []
+        tr.observe(tenant=None, ok=False, latency_s=1.0, t=51.0)
+        assert len(tr.check(t=51.0)) == 1  # fires again after clearing
+
+    def test_attainment_and_advise(self):
+        from tiny_deepspeed_tpu.telemetry.slo import (
+            SLOObjective, SLOTracker,
+        )
+        tr = SLOTracker(default=SLOObjective(target=0.5))
+        for i, ok in enumerate((True, True, True, False)):
+            tr.observe(tenant="t1", ok=ok, latency_s=0.1,
+                       replica=i % 2, t=float(i))
+        assert tr.attainment("t1") == 0.75
+        assert tr.attainment() == 0.75
+        # the failure landed on replica 1 (i=3): advise penalizes it
+        assert tr.advise(1, t=4.0) > tr.advise(0, t=4.0)
+        assert tr.advise(7, t=4.0) == 0.0  # no traffic advises nothing
+        snap = tr.snapshot(t=4.0)
+        assert snap["tenants"]["t1"]["attainment"] == 0.75
+        assert snap["tenants"]["t1"]["budget_spent_frac"] == \
+            pytest.approx(0.5)
+
+    def test_slo_record_validates_under_schema_v15(self):
+        from tiny_deepspeed_tpu.telemetry import schema
+        from tiny_deepspeed_tpu.telemetry.slo import SLOTracker
+        assert schema.SCHEMA_VERSION >= 15
+        assert "slo" in schema.META_KINDS
+        recs = []
+
+        class _Log:
+            def log_meta(self, **kw):
+                recs.append(kw)
+
+        tr = SLOTracker()
+        tr.observe(tenant="a", ok=True, latency_s=0.1, t=1.0)
+        tr.record(_Log(), step=7)
+        assert recs and recs[0]["kind"] == "slo"
+        assert recs[0]["at_step"] == 7
+        rec = dict(recs[0], ts=0.0)
+        assert not schema.validate_record(rec), \
+            schema.validate_record(rec)
+
+    def test_fast_burn_arms_flight_and_persists_record(self, model,
+                                                       params, tmp_path):
+        """Engine integration: a run whose every request blows its
+        latency objective trips fast burn at the first terminal —
+        the flight ring flushes with reason slo_fast_burn and an `slo`
+        record lands in the sidecar."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.telemetry import Telemetry
+        from tiny_deepspeed_tpu.telemetry.schema import SCHEMA_VERSION
+        from tiny_deepspeed_tpu.telemetry.slo import (
+            SLOObjective, SLOTracker,
+        )
+        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
+        path = str(tmp_path / "burn.jsonl")
+        with MetricsLogger(path, stdout=False) as ml:
+            ml.log_meta(schema_version=SCHEMA_VERSION, engine="serve:t")
+            eng = ServingEngine(model, params, _serve_config(),
+                                telemetry=Telemetry(), logger=ml)
+            # an objective nothing can meet: every terminal is bad, so
+            # burn = 1/budget = 20 — over the default fast threshold
+            eng.attach_slo(SLOTracker(
+                default=SLOObjective(target=0.95, latency_s=1e-9)))
+            r = eng.submit(_prompt(1, 7), 6)
+            eng.drain(max_ticks=100)
+        assert r.status == "ok"  # served fine — the SLO is what failed
+        metas = [json.loads(ln) for ln in open(path)]
+        slos = [m for m in metas if m.get("kind") == "slo"]
+        assert slos, "no slo record persisted on the alert"
+        assert slos[-1]["attainment"] == 0.0
+        assert any(a["kind"] == "fast_burn"
+                   for a in slos[-1]["alerts"])
+        flights = [m for m in metas if m.get("kind") == "flight"]
+        assert any(m.get("reason") == "slo_fast_burn" for m in flights), \
+            [m.get("reason") for m in flights]
+        from tiny_deepspeed_tpu.telemetry import schema
+        counts, errs = schema.validate_file(path)
+        assert not errs, errs[:5]
+
+
+# ---------------------------------------------------------------------------
+# cross-engine tracing (tentpole 3, satellite f)
+# ---------------------------------------------------------------------------
+
+class TestEventAttribution:
+    def test_marker_rule_unit(self):
+        """The ONE rule, on synthetic events: leave-markers attribute
+        backward to their replica, arrive-markers forward, the trailing
+        segment to the record's replica."""
+        from tiny_deepspeed_tpu.telemetry.trace import _event_replicas
+        events = [
+            ["submitted", 0.0],            # -> 0 (exported flushes back)
+            ["admitted", 0.1, 0],          # -> 0
+            ["exported", 0.2, 0, 0],       # leave: 0
+            ["imported", 0.3, 1, 1],       # arrive: 1, assigns forward
+            ["terminal:ok", 0.4, 1],       # -> 1
+        ]
+        assert _event_replicas(events, 1) == [0, 0, 0, 1, 1]
+        # no markers at all: everything belongs to the record's replica
+        assert _event_replicas([["submitted", 0.0], ["admitted", 0.1, 0]],
+                               None) == [None, None]
+        # engine_lost (leave) then recovered (arrive) — the failover
+        # shape: pre-death events on the dead replica, post on the
+        # sibling
+        events = [
+            ["submitted", 0.0],
+            ["engine_lost", 0.2, None, 0],
+            ["recovered", 0.3, None, 1],
+            ["admitted", 0.4, 0],
+            ["terminal:ok", 0.5, 0],
+        ]
+        assert _event_replicas(events, 1) == [0, 0, 1, 1, 1]
+
+    def test_trace_id_survives_journal_recovery(self, model, params,
+                                                tmp_path):
+        """trace_id is derived from the request id, so a journal replay
+        onto a sibling reconstructs the SAME id — correlation survives
+        the crash it exists to explain."""
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        jp = str(tmp_path / "trace.jsonl")
+        a = ServingEngine(model, params, _serve_config(), journal=jp)
+        orig = a.submit(_prompt(1, 7), 6)
+        assert orig.trace_id == f"t{orig.id:06d}"
+        b = ServingEngine(model, params, _serve_config())
+        rec = b.recover(journal=jp)
+        assert len(rec) == 1
+        assert rec[0].trace_id == orig.trace_id
+        b.drain(max_ticks=100)
+        assert rec[0].status == "ok"
+
+    def test_disagg_trace_spans_two_replica_processes(self, model,
+                                                      params, tmp_path):
+        """Half of THE acceptance: a disagg run's request has windows on
+        the prefill replica's process AND the decode replica's process,
+        correlated by args.trace_id, with the migration wait labeled."""
+        from tiny_deepspeed_tpu.fleet import DisaggEngine
+        from tiny_deepspeed_tpu.telemetry import trace
+        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
+        jsonl = str(tmp_path / "disagg.jsonl")
+        with MetricsLogger(jsonl, stdout=False) as logger:
+            dis = DisaggEngine(model, params, _serve_config(),
+                               logger=logger)
+            reqs = [dis.submit(_prompt(s, 7), 8) for s in (1, 2)]
+            dis.drain(max_ticks=300)
+        assert all(r.status == "ok" for r in reqs)
+        metas, _, errs = trace.load_run(jsonl)
+        assert not errs
+        doc = trace.serving_chrome_trace(metas, source=jsonl)
+        assert doc["otherData"]["replicas"] == [0, 1]
+        tid = reqs[0].trace_id
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"
+                 and e.get("args", {}).get("trace_id") == tid]
+        pids = {e["pid"] for e in spans}
+        assert pids == {2, 3}, (pids, spans)  # replica 0 AND replica 1
+        assert any(e["args"].get("window") == "migration wait"
+                   for e in spans), [e["args"] for e in spans]
+        # comp_migrate_s rides the record and partitions with the rest
+        rec = next(m for m in metas if m.get("kind") == "request"
+                   and m.get("trace_id") == tid)
+        assert rec.get("comp_migrate_s", 0.0) > 0.0
+        comp = sum(rec[k] for k in rec if k.startswith("comp_"))
+        assert comp == pytest.approx(rec["lat_s"], abs=2e-5)
+
+    def test_failover_trace_and_midrun_scrape(self, model, params,
+                                              tmp_path):
+        """THE acceptance, failover half: chaos engine_kill mid-trace,
+        the dead replica's requests finish on the sibling; the Chrome
+        trace shows one request's spans on both replica processes under
+        one trace_id, and /metrics scraped MID-RUN parses with
+        per-replica labels."""
+        from tiny_deepspeed_tpu.fleet import FleetRouter
+        from tiny_deepspeed_tpu.resilience import Chaos, ChaosServingEngine
+        from tiny_deepspeed_tpu.serving import ServingEngine
+        from tiny_deepspeed_tpu.telemetry import Telemetry, live, trace
+        from tiny_deepspeed_tpu.telemetry.slo import SLOTracker
+        from tiny_deepspeed_tpu.utils.profiling import MetricsLogger
+        jsonl = str(tmp_path / "fleet.jsonl")
+        tel = Telemetry()
+        agg = live.LiveAggregator()
+        tracker = SLOTracker()
+        with MetricsLogger(jsonl, stdout=False) as logger:
+            engines = []
+            for i in range(2):
+                e = ServingEngine(
+                    model, params, _serve_config(),
+                    journal=str(tmp_path / f"j.r{i}.jsonl"),
+                    replica_id=i, telemetry=tel, logger=logger)
+                if i == 0:
+                    e = ChaosServingEngine(
+                        e, Chaos(seed=3, engine_kill_step=3))
+                engines.append(e)
+            router = FleetRouter(engines, telemetry=tel, logger=logger)
+            router.attach_live(agg)
+            router.attach_slo(tracker)
+            reqs = [router.submit(_prompt(s, 7), 10)
+                    for s in (1, 2, 3, 4)]
+            with live.LiveExporter(agg, slo=tracker, port=0) as exp:
+                for _ in range(2):
+                    router.tick()
+                # the MID-RUN scrape: both replicas have ticked, the
+                # run is live, requests in flight
+                text = _get(f"http://127.0.0.1:{exp.port}/metrics")
+                doc = live.parse_prometheus_text(text)
+                qd = {lb.get("replica"): v for n, lb, v in doc["samples"]
+                      if n == "serve_queue_depth"}
+                assert "0" in qd and "1" in qd, doc["samples"][:10]
+                ticks = {lb["replica"] for n, lb, v in doc["samples"]
+                         if n == "live_ticks_total"}
+                assert ticks == {"0", "1"}
+                hz = json.loads(
+                    _get(f"http://127.0.0.1:{exp.port}/healthz"))
+                assert set(hz["replicas"]) == {"0", "1"}
+                router.drain(max_ticks=500)
+        assert router.failovers == 1
+        assert all(r.status == "ok" for r in reqs)
+        # a request that crossed the failover: its spans sit on BOTH
+        # replica processes under one trace_id
+        metas, _, errs = trace.load_run(jsonl)
+        assert not errs
+        doc = trace.serving_chrome_trace(metas, source=jsonl)
+        assert doc["otherData"]["replicas"] == [0, 1]
+        crossed = None
+        for r in reqs:
+            spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"
+                     and e.get("args", {}).get("trace_id") == r.trace_id]
+            if {e["pid"] for e in spans} == {2, 3}:
+                crossed = (r, spans)
+                break
+        assert crossed is not None, (
+            "no request's spans crossed both replica processes")
+        # the exporter aggregated both replicas' tick streams
+        assert set(agg.snapshot()["ticks"]) == {"0", "1"}
+
+    def test_flight_anchors_by_replica_key_in_shared_stream(self):
+        """Satellite f's two-replica fixture: both replicas' tick
+        counters run 0..2 in ONE interleaved stream.  A flight flush
+        carrying replica_id=1 must anchor on replica 1's tick — the
+        explicit-key half of the rule — even though replica 0's
+        same-numbered tick is nearer in file order; a flush WITHOUT
+        the key falls back to file order (last before, else first
+        after)."""
+        from tiny_deepspeed_tpu.telemetry import trace
+
+        def tick(rep, i, t):
+            return {"kind": "tick", "ts": t, "tick": i, "t_s": t,
+                    "wall_s": 0.01, "replica_id": rep}
+
+        metas = [
+            {"kind": "run_meta", "ts": 0.0, "serve": {"max_active": 1}},
+            tick(0, 0, 1.0), tick(1, 0, 1.5),
+            tick(0, 1, 2.0), tick(1, 1, 2.5),
+            tick(0, 2, 3.0),
+            {"kind": "flight", "ts": 3.1, "reason": "serve_restart",
+             "at_step": 1, "steps": [], "replica_id": 1},
+            {"kind": "flight", "ts": 3.2, "reason": "slo_fast_burn",
+             "at_step": 2, "steps": []},
+            tick(1, 2, 3.5),
+        ]
+        doc = trace.serving_chrome_trace(metas, source="fixture")
+        marks = [e for e in doc["traceEvents"]
+                 if e.get("name", "").startswith("flight flush")]
+        by_reason = {e["name"]: e for e in marks}
+        keyed = by_reason["flight flush (serve_restart)"]
+        # replica key wins: pid 3 (replica 1), anchored at ITS tick 1
+        # (t_s 2.5), not replica 0's nearer-in-file tick 1
+        assert keyed["pid"] == 3
+        assert keyed["ts"] == pytest.approx((2.5 - 1.0 + 0.01) * 1e6)
+        # no key: file order — last tick==2 written before the flush is
+        # replica 0's (t_s 3.0), so it lands on pid 2
+        unkeyed = by_reason["flight flush (slo_fast_burn)"]
+        assert unkeyed["pid"] == 2
+        assert unkeyed["ts"] == pytest.approx((3.0 - 1.0 + 0.01) * 1e6)
+
+
+# ---------------------------------------------------------------------------
+# dashboards + CLI surfaces (satellites b, e)
+# ---------------------------------------------------------------------------
+
+class TestReportSurfaces:
+    def _report(self, metas):
+        import importlib.util
+        import os
+        spec = importlib.util.spec_from_file_location(
+            "serve_report_under_test",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "scripts",
+                "serve_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.render_serve_report(metas, source="t.jsonl")
+
+    def test_slo_budget_section_and_replica_gauges(self):
+        metas = [
+            {"kind": "run_meta", "ts": 0.0, "serve": {"max_active": 1}},
+            {"kind": "tick", "ts": 1.0, "tick": 0, "t_s": 1.0,
+             "wall_s": 0.01, "replica_id": 0},
+            {"kind": "request", "ts": 2.0, "request_id": 0,
+             "prompt_tokens": 4, "new_tokens": 2, "preemptions": 0,
+             "status": "ok", "finish": "length", "lat_s": 0.5,
+             "replica_id": 0},
+            {"kind": "slo", "ts": 3.0, "windows": {"s": [30.0, 300.0]},
+             "attainment": 0.75,
+             "tenants": {"t1": {
+                 "objective": {"target": 0.9, "ttft_s": None,
+                               "latency_s": 5.0},
+                 "requests": 4, "good": 3, "attainment": 0.75,
+                 "budget_spent_frac": 1.0,
+                 "burn": {"30s": 10.0, "300s": 2.5}}},
+             "alerts": [{"tenant": "t1", "kind": "fast_burn",
+                         "burn": 10.0, "window_s": 30.0,
+                         "threshold": 14.0, "t": 2.5}]},
+            {"kind": "telemetry_summary", "ts": 4.0, "gauges": {
+                "serve_queue_depth{replica=0}": 2.0,
+                "serve_queue_depth{replica=1}": 0.0,
+                "serve_restarts{replica=1}": 1.0}},
+            {"kind": "flight", "ts": 5.0, "reason": "slo_fast_burn",
+             "at_step": 0, "steps": []},
+        ]
+        rep = self._report(metas)
+        assert "## SLO budgets" in rep
+        assert "75.00%" in rep                 # attainment formatting
+        assert "fast_burn" in rep and "t1" in rep
+        assert "Per-replica gauges" in rep
+        # both replicas' rows render from the labeled keys
+        assert "| 0 | 2 |" in rep and "| 1 | 0 |" in rep, rep
+        assert "slo_fast_burn" in rep          # flights filter widened
+
+    def test_migrate_component_in_tail_table(self):
+        metas = [
+            {"kind": "run_meta", "ts": 0.0, "serve": {"max_active": 1}},
+            {"kind": "tick", "ts": 1.0, "tick": 0, "t_s": 1.0,
+             "wall_s": 0.01},
+            {"kind": "request", "ts": 2.0, "request_id": 0,
+             "prompt_tokens": 4, "new_tokens": 2, "preemptions": 0,
+             "status": "ok", "finish": "length", "lat_s": 1.0,
+             "comp_queue_s": 0.1, "comp_prefill_s": 0.1,
+             "comp_decode_s": 0.1, "comp_preempt_s": 0.0,
+             "comp_restart_s": 0.0, "comp_migrate_s": 0.7},
+        ]
+        rep = self._report(metas)
+        assert "migration-wait" in rep
+        assert "**migration-wait** dominates" in rep
+
+    def test_serve_bench_live_smoke(self, tmp_path):
+        """The CLI smoke (satellite b): --live-port 0 + --slo on a tiny
+        closed-loop run — exporter line on stderr, slo block in the
+        summary JSON, an `slo` record in the sidecar, and both
+        report_run --check and serve_report accept the file."""
+        import os
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        sidecar = str(tmp_path / "live.jsonl")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "serve_bench.py"),
+             "--cpu", "--requests", "4", "--closed-loop",
+             "--prompt-lens", "8,12", "--max-new-tokens", "6",
+             "--live-port", "0", "--slo", "target=0.9,latency=60",
+             "--jsonl", sidecar],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=repo)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "live exporter -> http://127.0.0.1:" in out.stderr
+        assert "aggregated" in out.stderr  # scrape/tick stats line
+        summary = json.loads(out.stdout.strip().splitlines()[-1])
+        assert summary["slo"]["attainment"] == 1.0
+        metas = [json.loads(ln) for ln in open(sidecar)]
+        assert any(m.get("kind") == "slo" for m in metas)
+        chk = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "report_run.py"),
+             "--check", sidecar],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=repo)
+        assert chk.returncode == 0, chk.stdout + chk.stderr
+        rep = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "serve_report.py"), sidecar],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=repo)
+        assert rep.returncode == 0, rep.stdout + rep.stderr
+        assert "## SLO budgets" in rep.stdout

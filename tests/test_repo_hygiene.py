@@ -556,6 +556,90 @@ def test_wire_agenda_schema_v13_names():
         )
 
 
+def test_live_slo_schema_v15_names():
+    """Schema-v15 drift guard (live observability plane): the `slo`
+    record kind and the cross-engine tracing fields must stay
+    documented, the engine must keep stamping trace_id / comp_migrate_s
+    and arming the slo_fast_burn flight, the registry must keep
+    label-qualifying gauge keys through telemetry/live.gauge_key, and
+    serve_bench must keep the --slo / --live-port surfaces the docs
+    name — `report_run.py --check` hard-fails any v15 sidecar
+    otherwise."""
+    from tiny_deepspeed_tpu.telemetry import schema
+
+    assert schema.SCHEMA_VERSION >= 15
+    assert "slo" in schema.META_KINDS
+    for field in ("trace_id", "comp_migrate_s", "windows", "tenants",
+                  "attainment", "alerts"):
+        assert field in schema.META_FIELDS, field
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "serving", "engine.py")) as f:
+        engine_src = f.read()
+    for name in ("trace_id", "slo_fast_burn", "attach_slo",
+                 "attach_live"):
+        assert name in engine_src, (
+            f"{name} gone from serving/engine.py — the live plane and "
+            "cross-engine tracing key on it"
+        )
+    assert "comp_migrate_s" in engine_src, (
+        "comp_migrate_s gone from serving/engine.py record stamping — "
+        "the disagg tail attribution keys on it"
+    )
+    with open(os.path.join(
+            REPO, "tiny_deepspeed_tpu", "telemetry", "registry.py")) as f:
+        reg_src = f.read()
+    assert "gauge_key" in reg_src, (
+        "registry gauges no longer label-qualified via "
+        "telemetry/live.gauge_key — fleet replicas would regress to "
+        "last-writer-wins shared gauges"
+    )
+    with open(os.path.join(REPO, "scripts", "serve_bench.py")) as f:
+        bench_src = f.read()
+    for flag in ("--slo", "--live-port"):
+        assert flag in bench_src, (
+            f"serve_bench {flag} gone — README's observability recipe "
+            "and the live smoke test drive it"
+        )
+    # a v15 slo record (the SLOTracker.record shape) validates
+    errs = schema.validate_record({
+        "kind": "slo", "ts": 0.0, "windows": {"s": [30.0, 300.0]},
+        "tenants": {"_default": {
+            "objective": {"target": 0.99, "ttft_s": None,
+                          "latency_s": None},
+            "requests": 10, "good": 9, "attainment": 0.9,
+            "budget_spent_frac": 1.0,
+            "burn": {"30s": 10.0, "300s": 2.0}}},
+        "attainment": 0.9, "at_step": 12,
+        "alerts": [{"tenant": "_default", "kind": "fast_burn",
+                    "burn": 10.0, "window_s": 30.0, "threshold": 14.0,
+                    "t": 1.5}],
+    })
+    assert not errs, errs
+    # a v15 request record: trace_id correlation + the migrate
+    # component joining the latency partition
+    errs = schema.validate_record({
+        "kind": "request", "ts": 0.0, "request_id": 1,
+        "prompt_tokens": 8, "new_tokens": 4, "preemptions": 0,
+        "status": "ok", "finish": "length", "lat_s": 0.5,
+        "comp_queue_s": 0.1, "comp_prefill_s": 0.1,
+        "comp_decode_s": 0.1, "comp_preempt_s": 0.0,
+        "comp_restart_s": 0.0, "comp_migrate_s": 0.2,
+        "trace_id": "t000001", "replica_id": 1,
+        "events": [["submitted", 0.0], ["exported", 0.1, 0, 0],
+                   ["imported", 0.2, 1, 1], ["terminal:ok", 0.5, 1]],
+    })
+    assert not errs, errs
+    # labeled gauge keys in a telemetry_summary validate as plain dict
+    # entries (the key carries the label, the schema names the base)
+    errs = schema.validate_record({
+        "kind": "telemetry_summary", "ts": 0.0,
+        "gauges": {"serve_queue_depth{replica=0}": 1.0,
+                   "serve_queue_depth{replica=1}": 0.0},
+        "counters": {}, "histograms": {},
+    })
+    assert not errs, errs
+
+
 def test_perf_diff_check_committed_trajectory():
     """CI wiring for the perf regression sentinel: `perf_diff --check`
     must run green against the committed BENCH_*.json trajectory.  A

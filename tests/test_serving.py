@@ -846,6 +846,27 @@ se2 = ServingEngine(model, model.init(jax.random.PRNGKey(0)),
                     ServeConfig(max_active=2, num_blocks=4,
                                 block_tokens=8, spec_draft="ngram",
                                 spec_k=2))
+# ...and the live observability plane ON (schema v15): telemetry +
+# aggregator + SLO tracker attached, the /metrics exporter serving on a
+# loopback port, a request actually served and scraped through it — all
+# host-side by contract, so the training HLO must still not move
+from tiny_deepspeed_tpu.telemetry import Telemetry
+from tiny_deepspeed_tpu.telemetry.live import LiveAggregator, LiveExporter
+from tiny_deepspeed_tpu.telemetry.slo import SLOTracker
+import urllib.request
+se.telemetry = Telemetry()
+agg = LiveAggregator()
+exp = LiveExporter(agg, slo=SLOTracker(), port=0)
+lport = exp.start()
+se.attach_live(agg)
+se.attach_slo(SLOTracker())
+lr = se.submit([1, 2, 3], 2)
+se.drain(max_ticks=50)
+assert lr.status == "ok", lr.status
+scrape = urllib.request.urlopen(
+    f"http://127.0.0.1:{lport}/metrics", timeout=10).read().decode()
+assert "serve_tokens_total" in scrape, scrape[:200]
+exp.stop()
 eng2 = SingleDevice(GPT2Model(cfg), SGD(lr=0.1))
 state2 = eng2.init(jax.random.PRNGKey(0))
 after = eng2._step.lower(state2, batch).as_text()

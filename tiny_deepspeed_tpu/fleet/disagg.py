@@ -135,6 +135,19 @@ class DisaggEngine:
         self.migrated_bytes = 0
         self.bytes_by_link: Dict[str, int] = {}
 
+    # -- live plane / SLO wiring --------------------------------------------
+
+    def attach_slo(self, tracker) -> None:
+        """One budget across the pair: a request migrated to the decode
+        engine terminates THERE, so both engines observe into the same
+        tracker (the prefill side still terminates door sheds)."""
+        self.prefill.attach_slo(tracker)
+        self.decode.attach_slo(tracker)
+
+    def attach_live(self, aggregator) -> None:
+        self.prefill.attach_live(aggregator)
+        self.decode.attach_live(aggregator)
+
     # -- scheduling ---------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens, *, deadline_s=None,

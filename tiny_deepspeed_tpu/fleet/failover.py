@@ -21,7 +21,14 @@ properties make the handoff exact and invisible to callers:
     continuation a pure function of (params, prompt, seed); the tokens
     lost with the dead engine's uncommitted buffer re-decode to the
     same values (the headline fleet acceptance, pinned in
-    tests/test_fleet.py at temperature 0 by argmax equality).
+    tests/test_fleet.py at temperature 0 by argmax equality);
+  * trace-correlated — the `trace_id` stamped at submit rides both the
+    adopted handle and the journal's submit line, and the dead
+    engine's `abandon()` / the sibling's `recover()` stamp
+    replica-annotated `engine_lost` / `recovered` lifecycle events, so
+    the request's spans before and after the failover land on the
+    right per-replica tracks in `serving_chrome_trace` under ONE
+    trace_id.
 
 The sibling also RE-JOURNALS every adopted request into its own WAL
 (recover()'s cross-journal path), so a second failure replays from the

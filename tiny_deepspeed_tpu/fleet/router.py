@@ -57,6 +57,13 @@ _HEALTH_WEIGHT = 0.5
 # well under one queued request so real load imbalance still wins
 _TENANT_AFFINITY = 0.25
 
+# SLO-burn advisory weight: with an SLOTracker attached, a replica's
+# recent bad-request fraction (telemetry/slo.py advise()) adds up to
+# this much to its score — ADVISORY by design: it nudges dispatch away
+# from a replica that is burning the budget, never vetoes it, and with
+# no tracker attached scoring is byte-identical to pre-v15
+_SLO_WEIGHT = 0.5
+
 
 class _LockedLogger:
     """Serializes a shared MetricsLogger across concurrently ticking
@@ -161,9 +168,9 @@ class FleetRouter:
         # tests and single-core boxes.  Shared-sink rules under
         # concurrency: the MetricsLogger is lock-wrapped below (whole
         # lines), telemetry Counters lock internally, Histogram.observe
-        # is a GIL-atomic append — and shared GAUGES are last-writer-
-        # wins across replicas, which is already their semantic when N
-        # engines write one registry sequentially.
+        # is a GIL-atomic append — and GAUGES carry a replica label
+        # (serve_queue_depth{replica=0}), so N engines writing one
+        # registry each own their keys instead of last-writer-wins.
         self.parallel = bool(parallel)
         self._pool_exec: Optional[ThreadPoolExecutor] = None
         if self.parallel:
@@ -188,7 +195,26 @@ class FleetRouter:
         # score rewards: that replica's prefix cache is warm for this
         # tenant's shared prompts)
         self._tenant_last: Dict[str, int] = {}
+        # advisory SLO-burn state (attach_slo): consulted in _score
+        self._slo = None
         self._update_gauges()
+
+    # -- live plane / SLO wiring --------------------------------------------
+
+    def attach_slo(self, tracker) -> None:
+        """Fan an SLO tracker out to every replica (terminal requests
+        observe into ONE budget) and keep it for the advisory dispatch
+        hook in `_score`."""
+        self._slo = tracker
+        for r in self.replicas:
+            r.raw.attach_slo(tracker)
+
+    def attach_live(self, aggregator) -> None:
+        """Fan a live-plane aggregator out to every replica: each
+        engine pushes its per-tick registry snapshot (gauges carry the
+        replica label), so one /metrics surface serves the fleet."""
+        for r in self.replicas:
+            r.raw.attach_live(aggregator)
 
     # -- dispatch -----------------------------------------------------------
 
@@ -212,6 +238,11 @@ class FleetRouter:
         primary = load * (1.0 + gap) + _HEALTH_WEIGHT * health
         if tenant is not None and self._tenant_last.get(tenant) == r.id:
             primary -= _TENANT_AFFINITY
+        if self._slo is not None:
+            # advisory burn consultation: a replica whose recent
+            # terminals are burning the error budget scores heavier —
+            # bounded (advise() is a fraction), never a veto
+            primary += _SLO_WEIGHT * self._slo.advise(r.id)
         return (primary, pool, r.id)
 
     def _meets(self, r: Replica, max_new_tokens: int,

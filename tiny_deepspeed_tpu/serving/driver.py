@@ -108,7 +108,8 @@ def _latency_stats(lats: List[float]) -> dict:
 
 def run_trace(engine, trace: Sequence[Arrival], *,
               realtime: bool = True, max_ticks: int = 200_000,
-              no_progress_ticks: int = 2_000) -> dict:
+              no_progress_ticks: int = 2_000,
+              slo=None, live=None) -> dict:
     """Drive `engine` (serving.ServingEngine or a ChaosServingEngine
     wrapper) through the trace.
 
@@ -126,6 +127,13 @@ def run_trace(engine, trace: Sequence[Arrival], *,
     after the pool shrank) ticks forever producing nothing.  After that
     many CONSECUTIVE zero-token ticks with work still pending, raise
     with the queue/pool state named instead of spinning to max_ticks."""
+    if slo is not None:
+        # SLO error budgets (telemetry/slo.py): attached through the
+        # engine's own hook so fleet/disagg/chaos wrappers fan the
+        # tracker out to every underlying engine
+        engine.attach_slo(slo)
+    if live is not None:
+        engine.attach_live(live)
     requests = []
     pending = list(trace)
     occupancy = []
@@ -204,7 +212,8 @@ def run_trace(engine, trace: Sequence[Arrival], *,
     # the bench-JSON view of what serve_report.py breaks down per tail
     comp_totals = {
         k: round(sum(r.lat_components[k] for r in requests), 4)
-        for k in ("queue", "prefill", "decode", "preempt", "restart")
+        for k in ("queue", "prefill", "decode", "preempt", "restart",
+                  "migrate")
     }
     # per-tenant aggregates (absent on untagged traffic): goodput,
     # p99 TTFT / end-to-end latency, and terminal outcomes per tenant
@@ -284,6 +293,8 @@ def run_trace(engine, trace: Sequence[Arrival], *,
         out["tenants"] = tenants_out
     if prefix_out is not None:
         out["prefix_cache"] = prefix_out
+    if slo is not None:
+        out["slo"] = slo.snapshot()
     return out
 
 

@@ -250,8 +250,18 @@ class Request:
     def __init__(self, prompt: Sequence[int], max_new_tokens: int, *,
                  deadline_s: Optional[float] = None,
                  seed: Optional[int] = None, id: Optional[int] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 trace_id: Optional[str] = None):
         self.id = next(Request._ids) if id is None else int(id)
+        # cross-engine trace correlation: stamped ONCE at submit and
+        # carried through disagg migration (the object itself moves),
+        # failover adoption (handles are reused), and journal recovery
+        # (persisted on the submit line).  The default derives from the
+        # id, so a pre-v15 journal replays to the SAME trace_id the
+        # original submit stamped — correlation survives even journals
+        # that predate the field.
+        self.trace_id = (f"t{self.id:06d}" if trace_id is None
+                         else str(trace_id))
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_s = None if deadline_s is None else float(deadline_s)
@@ -296,7 +306,7 @@ class Request:
         # that opens the next, so the sum telescopes to t_done-t_arrival
         self.lat_components = {"queue": 0.0, "prefill": 0.0,
                                "decode": 0.0, "preempt": 0.0,
-                               "restart": 0.0}
+                               "restart": 0.0, "migrate": 0.0}
         self._wait_since: Optional[float] = now
         self._wait_kind = "queue"
         self.last_slot: Optional[int] = None
@@ -309,8 +319,23 @@ class Request:
         self.kv_migration_bytes = 0
         self.kv_migration_link: Optional[str] = None
 
-    def event(self, name: str, t: float, slot: Optional[int] = None):
-        self.events.append((name, t) if slot is None else (name, t, slot))
+    def event(self, name: str, t: float, slot: Optional[int] = None,
+              replica: Optional[int] = None):
+        """Append a lifecycle event.  `replica` stamps the CROSS-ENGINE
+        markers (exported/imported/recovered/engine_lost) with the
+        engine they left or arrived at, so one request's spans render
+        on correlated per-replica tracks: a marker that leaves an
+        engine (exported, engine_lost) attributes the events since the
+        previous marker to its replica; one that arrives (imported,
+        recovered) attributes the events after it.  Serialized as
+        [name, t], [name, t, slot], or [name, t, slot, replica] —
+        single-engine events keep their historical 2/3-tuple shape."""
+        e: tuple = (name, t)
+        if slot is not None or replica is not None:
+            e += (slot,)
+        if replica is not None:
+            e += (replica,)
+        self.events.append(e)
 
     @property
     def done(self) -> bool:
@@ -400,6 +425,15 @@ class ServingEngine:
         self.config = config
         self.telemetry = telemetry
         self.logger = logger
+        # live observability plane (telemetry/live.py): when attached,
+        # each tick pushes the registry snapshot (host dicts only) into
+        # the aggregator the /metrics exporter reads — opt-in, strictly
+        # off the compiled path
+        self.live = None
+        # SLO error budgets (telemetry/slo.py): when attached, every
+        # terminal request is observed and fast-burn alerts arm the
+        # flight ring
+        self.slo = None
         # fleet identity: stamped on this engine's request/tick records
         # when set (fleet/router.py, fleet/disagg.py) so one metrics
         # stream can carry a whole fleet; None keeps single-engine
@@ -657,6 +691,19 @@ class ServingEngine:
             block_tokens=int(self.config.block_tokens),
         )
 
+    def attach_slo(self, tracker) -> None:
+        """Attach an SLO error-budget tracker (telemetry/slo.py): every
+        terminal request is observed, fast burn arms the flight ring.
+        A METHOD (not a bare attr) so chaos/fleet wrappers can fan it
+        out — setattr on a delegating wrapper would strand the tracker
+        on the wrapper while the inner engine reads its own None."""
+        self.slo = tracker
+
+    def attach_live(self, aggregator) -> None:
+        """Attach a live-plane aggregator (telemetry/live.py): each
+        tick pushes the registry snapshot for the /metrics exporter."""
+        self.live = aggregator
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                deadline_s: Optional[float] = None,
                seed: Optional[int] = None,
@@ -767,6 +814,12 @@ class ServingEngine:
             self._restarts_since_progress = 0
         self._update_gauges()
         self._record_tick(tick_i, t0, produced)
+        if self.live is not None and self.telemetry is not None:
+            # push the tick's registry snapshot into the live plane:
+            # plain host dicts (floats), so the exporter thread can
+            # never reach a device value through the aggregator
+            self.live.ingest(self.telemetry.snapshot(),
+                             replica=self.replica_id)
         return produced
 
     def drain(self, max_ticks: Optional[int] = None) -> int:
@@ -873,17 +926,19 @@ class ServingEngine:
                 req.finish_reason = None
                 if req._wait_since is None:
                     req._wait_since, req._wait_kind = now, "restart"
-                req.event("recovered", now)
+                req.event("recovered", now, replica=self.replica_id)
             else:
                 req = Request(e["prompt"], e["max_new"],
                               deadline_s=e["deadline_s"], seed=e["seed"],
-                              id=e["id"], tenant=e.get("tenant"))
+                              id=e["id"], tenant=e.get("tenant"),
+                              trace_id=e.get("trace"))
                 req.tokens = list(e["tokens"])
                 # the wait from recovery to re-admission is restart
                 # overhead, not queue wait: the crash-restart cycle (not
                 # arrival pressure) is what the request is paying for
                 req._wait_kind = "restart"
-                req.event("recovered", req.t_arrival)
+                req.event("recovered", req.t_arrival,
+                          replica=self.replica_id)
             if cross:
                 self.journal.submit(req)
                 self.journal.tokens(req.id, req.tokens)
@@ -907,7 +962,9 @@ class ServingEngine:
         # how many requests re-queued into the metrics stream
         if self._flight is not None and self.logger is not None:
             self._flight.flush(self.logger, "serve_recover",
-                               at_step=self._ticks)
+                               at_step=self._ticks,
+                               **({"replica_id": self.replica_id}
+                                  if self.replica_id is not None else {}))
         return out
 
     # -- disaggregation hooks (fleet/disagg.py) -----------------------------
@@ -920,7 +977,8 @@ class ServingEngine:
         scales, the same 4x compression it rests at); the slot's blocks
         return to this engine's free list immediately (the gather
         materialized fresh arrays).  The request re-opens a wait window
-        — billed to queue-wait — until the importing engine seats it."""
+        — billed to migration-wait (`comp_migrate_s`) — until the
+        importing engine seats it."""
         slot = self._slots[i]
         if slot is None:
             raise ValueError(f"slot {i} is empty — nothing to export")
@@ -931,8 +989,12 @@ class ServingEngine:
         self._slots[i] = None
         self._close_active(req, slot, now)
         req.state = "queued"
-        req._wait_since, req._wait_kind = now, "queue"
-        req.event("exported", now, i)
+        # the window until the importing engine seats it is MIGRATION
+        # wait, not queue wait: the request isn't contending for this
+        # engine's slots, it's paying the cross-engine handoff — the
+        # component serve_report's cross-engine tail attribution reads
+        req._wait_since, req._wait_kind = now, "migrate"
+        req.event("exported", now, i, replica=self.replica_id)
         return KVHandoff(req=req, payload=payload, pos=slot.pos,
                          last=slot.last,
                          block_tokens=self.config.block_tokens,
@@ -990,7 +1052,7 @@ class ServingEngine:
             req._wait_since = None
         if req.t_admitted is None:
             req.t_admitted = now
-        req.event("imported", now, slot_i)
+        req.event("imported", now, slot_i, replica=self.replica_id)
         req.last_slot = slot_i
         req.state = "active"
         self._slots[slot_i] = _Slot(req, table=ids, pos=handoff.pos,
@@ -1016,10 +1078,11 @@ class ServingEngine:
             s.req.state = "queued"
             self._close_active(s.req, s, now)
             s.req._wait_since, s.req._wait_kind = now, "restart"
-            s.req.event("engine_lost", now, i)
+            s.req.event("engine_lost", now, i,
+                        replica=self.replica_id)
         self._slots = [None] * self.config.max_active
         for req in self._queue:
-            req.event("engine_lost", now)
+            req.event("engine_lost", now, replica=self.replica_id)
         self._queue.clear()
         self._poison_pending.clear()
         if self._journal is not None:
@@ -1790,6 +1853,24 @@ class ServingEngine:
         req.event(f"terminal:{status}", req.t_done, slot)
         if self.journal is not None and req._journaled:
             self.journal.end(req.id, status, finish)
+        if self.slo is not None:
+            # error-budget accounting observes every terminal outcome
+            # (logger or not): good iff ok AND inside the objective's
+            # latency bounds.  A fast-burn transition arms the flight
+            # ring — the postmortem lands at the moment the budget
+            # started dying — and persists an `slo` record.
+            ttft = (None if req.t_first is None
+                    else req.t_first - req.t_arrival)
+            self.slo.observe(
+                tenant=req.tenant, ok=(status == "ok"), ttft_s=ttft,
+                latency_s=req.t_done - req.t_arrival,
+                replica=self.replica_id, t=req.t_done)
+            alerts = self.slo.check(t=req.t_done)
+            if alerts:
+                if any(a["kind"] == "fast_burn" for a in alerts):
+                    self._arm_flight("slo_fast_burn")
+                if self.logger is not None:
+                    self.slo.record(self.logger, step=self._ticks)
         if self.logger is not None:
             comp = req.lat_components
             rec = dict(
@@ -1805,9 +1886,15 @@ class ServingEngine:
                 comp_decode_s=round(comp["decode"], 6),
                 comp_preempt_s=round(comp["preempt"], 6),
                 comp_restart_s=round(comp["restart"], 6),
+                trace_id=req.trace_id,
                 events=[[e[0], round(e[1], 6)] + list(e[2:])
                         for e in req.events],
             )
+            if comp["migrate"]:
+                # cross-engine handoff wait (disagg export -> import):
+                # only migrated requests carry it, so single-engine
+                # records keep the pre-v15 five-way partition
+                rec["comp_migrate_s"] = round(comp["migrate"], 6)
             if req.last_slot is not None:
                 rec["slot"] = req.last_slot
             if self.replica_id is not None:
@@ -1870,45 +1957,60 @@ class ServingEngine:
         if self.telemetry is None:
             return
         t = self.telemetry
+        # fleet replicas share one registry and tick in parallel: the
+        # replica label keeps each engine's gauges on its OWN key
+        # (serve_queue_depth{replica=0}) instead of last-writer-wins
+        # over a shared one.  replica=None drops the label, so
+        # single-engine runs keep their historical bare keys.
+        rid = self.replica_id
         t.gauge("serve_batch_occupancy",
-                self.n_active / self.config.max_active)
+                self.n_active / self.config.max_active, replica=rid)
         t.gauge("serve_pool_utilization",
-                self.pool.blocks_in_use / self.pool.num_usable)
-        t.gauge("serve_queue_depth", float(len(self._queue)))
+                self.pool.blocks_in_use / self.pool.num_usable,
+                replica=rid)
+        t.gauge("serve_queue_depth", float(len(self._queue)),
+                replica=rid)
         t.gauge("serve_eviction_rate",
-                self._evictions / max(1, self._ticks))
-        t.gauge("serve_shed", float(self._shed))
-        t.gauge("serve_expired", float(self._expired))
-        t.gauge("serve_quarantined", float(self._quarantined))
-        t.gauge("serve_restarts", float(self._restarts))
+                self._evictions / max(1, self._ticks), replica=rid)
+        t.gauge("serve_shed", float(self._shed), replica=rid)
+        t.gauge("serve_expired", float(self._expired), replica=rid)
+        t.gauge("serve_quarantined", float(self._quarantined),
+                replica=rid)
+        t.gauge("serve_restarts", float(self._restarts), replica=rid)
         if self._spec is not None:
             t.gauge("serve_spec_accept_rate",
-                    self._spec_accepted / max(1, self._spec_proposed))
+                    self._spec_accepted / max(1, self._spec_proposed),
+                    replica=rid)
             t.gauge("serve_spec_tokens_per_tick",
-                    self._spec_tokens / max(1, self._spec_ticks))
+                    self._spec_tokens / max(1, self._spec_ticks),
+                    replica=rid)
         if self._prefix is not None:
             pc = self._prefix
             t.gauge("serve_prefix_hit_rate",
-                    pc.tokens_avoided / max(1, pc.prompt_tokens))
+                    pc.tokens_avoided / max(1, pc.prompt_tokens),
+                    replica=rid)
             t.gauge("serve_prefix_blocks_aliased",
-                    float(pc.blocks_aliased))
+                    float(pc.blocks_aliased), replica=rid)
             t.gauge("serve_prefix_tokens_avoided",
-                    float(pc.tokens_avoided))
-            t.gauge("serve_prefix_cached_blocks", float(len(pc)))
+                    float(pc.tokens_avoided), replica=rid)
+            t.gauge("serve_prefix_cached_blocks", float(len(pc)),
+                    replica=rid)
             t.gauge("serve_prefix_pool_saved_bytes",
-                    float(self._prefix_saved_bytes()))
+                    float(self._prefix_saved_bytes()), replica=rid)
         if isinstance(self._queue, TenantQueue):
             active = {r.tenant for r in self._queue}
             active |= {s.req.tenant for s in self._slots
                        if s is not None}
             active.discard(None)
-            t.gauge("serve_tenants_active", float(len(active)))
+            t.gauge("serve_tenants_active", float(len(active)),
+                    replica=rid)
 
     # -- per-tick time series + serving flight recorder ---------------------
 
     # flush-trigger precedence when several fire in one tick: the record
     # names the gravest one (a restart subsumes its quarantines)
-    _FLIGHT_PRIORITY = {"serve_shed_burst": 1, "serve_quarantine": 2,
+    _FLIGHT_PRIORITY = {"serve_shed_burst": 1, "slo_fast_burn": 2,
+                        "serve_quarantine": 2,
                         "serve_restart": 3, "serve_recover": 3}
 
     def _arm_flight(self, reason: str) -> None:
@@ -1991,6 +2093,12 @@ class ServingEngine:
             )
         if self._flight_reason is not None:
             if self._flight is not None:
+                # the flush carries the writer's replica so trace_view's
+                # anchoring rule can pick among same-numbered ticks of a
+                # SHARED fleet stream by key instead of file order
                 self._flight.flush(self.logger, self._flight_reason,
-                                   at_step=tick_i)
+                                   at_step=tick_i,
+                                   **({"replica_id": self.replica_id}
+                                      if self.replica_id is not None
+                                      else {}))
             self._flight_reason = None

@@ -116,6 +116,13 @@ class RequestJournal:
             # multi-tenant attribution survives recovery; absent on
             # untagged traffic so pre-tenancy journals replay unchanged
             rec["tenant"] = tenant
+        trace = getattr(req, "trace_id", None)
+        if trace is not None:
+            # cross-engine trace correlation survives recovery the same
+            # way: the id stamped at submit() is the one a failover
+            # sibling re-admits under, so a request's spans on two
+            # replicas share a track key; absent pre-v15
+            rec["trace"] = trace
         self._append(rec)
 
     def tokens(self, req_id: int, toks: List[int]) -> None:
@@ -232,7 +239,8 @@ class RequestJournal:
                     "max_new": rec["max_new"],
                     "deadline_s": rec.get("deadline_s"),
                     "seed": rec.get("seed", rid),
-                    "tenant": rec.get("tenant"), "tokens": [],
+                    "tenant": rec.get("tenant"),
+                    "trace": rec.get("trace"), "tokens": [],
                 }
             elif ev == "tok" and rid in reqs:
                 reqs[rid]["tokens"].extend(rec["toks"])

@@ -33,6 +33,15 @@ package instruments a training run end to end:
     health.  `Telemetry(layers=True)` turns on the engine's per-layer
     health mode (grad/activation norms + non-finite counts INSIDE the
     block scan — the first-NaN layer localized in one step).
+  * `live` — the serving fleet's live plane: streaming aggregation of
+    registry snapshots into per-replica ring-buffered time series
+    (windowed quantiles, rates) and the opt-in stdlib HTTP exporter
+    serving /metrics (Prometheus text), /healthz and /slo — host-side
+    only, strictly off the compiled path.
+  * `slo` — per-tenant SLO objectives and multi-window error-budget
+    burn-rate accounting; the engine observes every terminal request
+    into an attached `SLOTracker`, fast-burn alerts flush the flight
+    ring, and the fleet router reads `advise()` as a routing signal.
 """
 
 from .health import (
@@ -41,7 +50,9 @@ from .health import (
 )
 from .flight import FlightRecorder
 from .registry import Telemetry
+from . import live
 from . import schema
+from . import slo
 from . import trace
 
 __all__ = [
@@ -52,6 +63,8 @@ __all__ = [
     "first_nonfinite_layer",
     "FlightRecorder",
     "Telemetry",
+    "live",
     "schema",
+    "slo",
     "trace",
 ]

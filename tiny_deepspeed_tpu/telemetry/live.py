@@ -1,0 +1,429 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""Live fleet observability plane: streaming metric aggregation and the
+opt-in ``/metrics`` exporter.
+
+Everything else in ``telemetry/`` is post-hoc — JSONL sidecars rendered
+by the report scripts after the run ends.  This module is the ONLINE
+half: engines push their registry snapshot once per tick (host dicts,
+already materialized — nothing here touches a device value), the
+aggregator folds the per-tick deltas into ring-buffered time series with
+per-replica labels, and an opt-in stdlib ``http.server`` thread exposes
+
+    /metrics   Prometheus text exposition (counters, labeled gauges,
+               histogram summaries with windowed quantiles)
+    /healthz   per-replica liveness: tick cadence, queue depth, guard
+               restarts, quarantine state
+    /slo       JSON error-budget snapshot from an attached
+               :class:`~tiny_deepspeed_tpu.telemetry.slo.SLOTracker`
+
+Strictly host-side and off the compiled path: the exporter reads only
+python floats under a lock, so a scrape can never force a device sync
+or perturb an engine tick (pinned by the poisoned-``__array__`` test,
+same style as the flight-recorder pin).
+
+Gauge labels
+------------
+The registry's shared-gauge wart (fleet replicas ticking in parallel
+overwrote each other's ``serve_*`` gauges last-writer-wins) is fixed by
+label-qualified gauge KEYS: call sites keep the literal base name
+(``tel.gauge("serve_queue_depth", v, replica=rid)``) and the registry
+stores ``serve_queue_depth{replica=0}``.  :func:`gauge_key` builds that
+key and :func:`parse_gauge_key` splits it back; both live here (pure
+stdlib) so jax-free scripts can path-import them next to ``trace.py``.
+
+This module imports NO third-party packages (no jax, no numpy): scripts
+load it with ``importlib`` to read sidecars without paying the jax
+import tax, and the exporter thread must not be able to touch a device
+even by accident.
+"""
+
+import io
+import json
+import re
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "gauge_key", "parse_gauge_key", "LiveAggregator", "LiveExporter",
+    "parse_prometheus_text",
+]
+
+_KEY_RE = re.compile(
+    r"^(?P<base>[A-Za-z_:][A-Za-z0-9_:]*)(?:\{(?P<labels>[^{}]*)\})?$")
+
+
+def gauge_key(name: str, **labels: Any) -> str:
+    """Label-qualified registry key: ``name{k=v,...}`` (sorted keys) —
+    the storage form for per-replica gauges.  No labels -> bare name,
+    so single-engine runs keep their historical gauge keys."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def parse_gauge_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """Split a registry gauge key back into (base_name, labels).  Keys
+    that never carried labels parse to ``(key, {})``, so readers handle
+    pre-v15 sidecars unchanged."""
+    m = _KEY_RE.match(key)
+    if m is None:
+        return key, {}
+    labels: Dict[str, str] = {}
+    raw = m.group("labels")
+    if raw:
+        for part in raw.split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                labels[k.strip()] = v.strip().strip('"')
+    return m.group("base"), labels
+
+
+def _fmt(v: Any) -> str:
+    """Prometheus sample value: finite floats as repr, everything else
+    via str() — never numpy, never __array__ (exporter no-sync pin)."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "NaN"
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return "{" + inner + "}"
+
+
+def _quantile(sorted_xs: List[float], q: float) -> float:
+    # deliberately duplicated from utils/profiling (same as trace.py):
+    # this module must stay importable without jax/numpy
+    if not sorted_xs:
+        return 0.0
+    if len(sorted_xs) == 1:
+        return sorted_xs[0]
+    pos = q * (len(sorted_xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_xs) - 1)
+    frac = pos - lo
+    return sorted_xs[lo] * (1 - frac) + sorted_xs[hi] * frac
+
+
+class _Ring:
+    """Fixed-capacity (t, value) series — the streaming window."""
+
+    __slots__ = ("points",)
+
+    def __init__(self, capacity: int):
+        self.points: deque = deque(maxlen=capacity)
+
+    def append(self, t: float, v: float) -> None:
+        self.points.append((t, v))
+
+    def values(self) -> List[float]:
+        return [v for _, v in self.points]
+
+    def rate(self, now: float, window_s: float) -> float:
+        """Sum of deltas inside the window / window span."""
+        lo = now - window_s
+        total = 0.0
+        t0 = None
+        for t, v in self.points:
+            if t < lo:
+                continue
+            if t0 is None:
+                t0 = t
+            total += v
+        if t0 is None or now <= t0:
+            return 0.0
+        return total / max(now - t0, 1e-9)
+
+
+class LiveAggregator:
+    """Streaming merge of per-tick registry snapshots across replicas.
+
+    Engines call :meth:`ingest` once per tick with the plain-dict
+    result of ``Telemetry.snapshot()`` (counters are fleet-wide when
+    the registry is shared; gauges arrive label-qualified per replica).
+    The aggregator keeps, per metric key: the latest value, a ring of
+    per-tick deltas (counters) or samples (gauges), and the histogram
+    summaries — enough for windowed p50/p95/p99 and rates without ever
+    re-reading the engine.  All state is python floats under one lock;
+    the exporter thread only formats, never computes on device values.
+    """
+
+    def __init__(self, window: int = 512):
+        self._lock = threading.Lock()
+        self._window = int(window)
+        self._counters: Dict[str, float] = {}       # latest cumulative
+        self._counter_rings: Dict[str, _Ring] = {}  # per-tick deltas
+        self._gauges: Dict[str, float] = {}         # latest, keyed w/labels
+        self._gauge_rings: Dict[str, _Ring] = {}
+        self._hists: Dict[str, Dict[str, float]] = {}
+        self._ticks: Dict[str, int] = {}            # per-replica tick count
+        self._last_tick_t: Dict[str, float] = {}
+        self.scrapes = 0
+
+    # ---- ingest (engine side, once per tick) -------------------------
+
+    def ingest(self, snapshot: Dict[str, Any], *,
+               replica: Optional[int] = None,
+               t: Optional[float] = None) -> None:
+        now = time.monotonic() if t is None else float(t)
+        rid = "-" if replica is None else str(replica)
+        with self._lock:
+            for name, v in (snapshot.get("counters") or {}).items():
+                v = float(v)
+                prev = self._counters.get(name, 0.0)
+                delta = v - prev
+                if delta < 0:       # registry reset: restart the series
+                    delta = v
+                self._counters[name] = v
+                ring = self._counter_rings.get(name)
+                if ring is None:
+                    ring = self._counter_rings[name] = _Ring(self._window)
+                if delta:
+                    ring.append(now, delta)
+            for key, v in (snapshot.get("gauges") or {}).items():
+                v = float(v)
+                self._gauges[key] = v
+                ring = self._gauge_rings.get(key)
+                if ring is None:
+                    ring = self._gauge_rings[key] = _Ring(self._window)
+                ring.append(now, v)
+            for name, summ in (snapshot.get("histograms") or {}).items():
+                self._hists[name] = dict(summ)
+            self._ticks[rid] = self._ticks.get(rid, 0) + 1
+            self._last_tick_t[rid] = now
+
+    # ---- queries (exporter side, under the same lock) ----------------
+
+    def window_quantiles(self, key: str) -> Dict[str, float]:
+        """p50/p95/p99 over the ring for a gauge key (streaming window,
+        not the all-time histogram)."""
+        with self._lock:
+            ring = self._gauge_rings.get(key)
+            xs = sorted(ring.values()) if ring else []
+        return {"p50": _quantile(xs, 0.50), "p95": _quantile(xs, 0.95),
+                "p99": _quantile(xs, 0.99)}
+
+    def rate(self, counter: str, window_s: float = 30.0,
+             t: Optional[float] = None) -> float:
+        now = time.monotonic() if t is None else float(t)
+        with self._lock:
+            ring = self._counter_rings.get(counter)
+            return ring.rate(now, window_s) if ring else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges),
+                    "histograms": {k: dict(v)
+                                   for k, v in self._hists.items()},
+                    "ticks": dict(self._ticks)}
+
+    # ---- export surfaces ---------------------------------------------
+
+    def prometheus_text(self, t: Optional[float] = None) -> str:
+        """Prometheus text exposition (format 0.0.4): counters as
+        ``<name>_total``, gauges with their registry labels, histograms
+        as summaries (quantile series + _count/_sum).  Pure string
+        formatting over floats — a scrape cannot sync a device."""
+        now = time.monotonic() if t is None else float(t)
+        with self._lock:
+            counters = dict(self._counters)
+            crates = {k: r.rate(now, 30.0)
+                      for k, r in self._counter_rings.items()}
+            gauges = dict(self._gauges)
+            hists = {k: dict(v) for k, v in self._hists.items()}
+            ticks = dict(self._ticks)
+            self.scrapes += 1
+        out = io.StringIO()
+        for name in sorted(counters):
+            out.write(f"# TYPE {name}_total counter\n")
+            out.write(f"{name}_total {_fmt(counters[name])}\n")
+            out.write(f"# TYPE {name}_rate gauge\n")
+            out.write(f"{name}_rate {_fmt(crates.get(name, 0.0))}\n")
+        by_base: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+        for key in sorted(gauges):
+            base, labels = parse_gauge_key(key)
+            by_base.setdefault(base, []).append((labels, gauges[key]))
+        for base in sorted(by_base):
+            out.write(f"# TYPE {base} gauge\n")
+            for labels, v in by_base[base]:
+                out.write(f"{base}{_label_str(labels)} {_fmt(v)}\n")
+        for name in sorted(hists):
+            h = hists[name]
+            out.write(f"# TYPE {name} summary\n")
+            for q, k in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                out.write(f'{name}{{quantile="{q}"}} '
+                          f"{_fmt(h.get(k, 0.0))}\n")
+            count = float(h.get("count", 0.0))
+            out.write(f"{name}_count {_fmt(count)}\n")
+            out.write(f"{name}_sum "
+                      f"{_fmt(float(h.get('mean', 0.0)) * count)}\n")
+        for rid in sorted(ticks):
+            out.write('live_ticks_total{replica="%s"} %s\n'
+                      % (rid, _fmt(ticks[rid])))
+        return out.getvalue()
+
+    def healthz(self, t: Optional[float] = None) -> Dict[str, Any]:
+        """Per-replica liveness from the labeled gauges: tick cadence,
+        queue depth, guard restarts, quarantine state."""
+        now = time.monotonic() if t is None else float(t)
+        with self._lock:
+            gauges = dict(self._gauges)
+            ticks = dict(self._ticks)
+            last = dict(self._last_tick_t)
+        replicas: Dict[str, Dict[str, Any]] = {}
+        for rid in ticks:
+            replicas[rid] = {
+                "ticks": ticks[rid],
+                "since_last_tick_s": round(now - last[rid], 3),
+            }
+        for key, v in gauges.items():
+            base, labels = parse_gauge_key(key)
+            rid = labels.get("replica", "-")
+            if base in ("serve_queue_depth", "serve_restarts",
+                        "serve_quarantined", "serve_batch_occupancy",
+                        "serve_pool_utilization"):
+                replicas.setdefault(rid, {})[base] = v
+        ok = all(r.get("serve_quarantined", 0) == 0
+                 for r in replicas.values())
+        return {"ok": bool(ok), "replicas": replicas}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the default handler logs every request to stderr — silence it:
+    # scrapes must not interleave with the bench's human output
+    def log_message(self, fmt, *args):  # noqa: A002
+        pass
+
+    def do_GET(self):  # noqa: N802
+        srv = self.server
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = srv.aggregator.prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/healthz":
+            body = json.dumps(srv.aggregator.healthz()).encode()
+            ctype = "application/json"
+        elif path == "/slo":
+            slo = srv.slo
+            body = json.dumps(
+                slo.snapshot() if slo is not None else {}).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class LiveExporter:
+    """Opt-in HTTP exporter thread over a :class:`LiveAggregator`.
+
+    stdlib ``ThreadingHTTPServer`` on a daemon thread, loopback by
+    default, port 0 -> OS-assigned (the actual port comes back from
+    :meth:`start`).  Nothing here runs unless the user asks for it
+    (``serve_bench.py --live-port`` or an explicit start() in code),
+    and the serving hot path never blocks on a scrape: engines push
+    snapshots into the aggregator and move on."""
+
+    def __init__(self, aggregator: LiveAggregator, *,
+                 slo: Any = None, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.aggregator = aggregator
+        self.slo = slo
+        self._host = host
+        self._port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> int:
+        if self._httpd is not None:
+            return self.port
+        httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
+        httpd.daemon_threads = True
+        httpd.aggregator = self.aggregator
+        httpd.slo = self.slo
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="live-exporter", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Any]:
+    """Minimal Prometheus text-format parser (the test round-trips
+    :meth:`LiveAggregator.prometheus_text` through this): returns
+    ``{"types": {name: type}, "samples": [(name, labels, value)]}``.
+    Rejects malformed lines loudly — a sidecar scrape that doesn't
+    parse is a bug, not noise."""
+    types: Dict[str, str] = {}
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    sample_re = re.compile(
+        r"^(?P<name>[A-Za-z_:][A-Za-z0-9_:]*)"
+        r"(?:\{(?P<labels>[^{}]*)\})?\s+(?P<value>\S+)$")
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            types[name] = mtype.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if m is None:
+            raise ValueError(f"unparseable metrics line: {line!r}")
+        labels: Dict[str, str] = {}
+        raw = m.group("labels")
+        if raw:
+            for part in raw.split(","):
+                if not part:
+                    continue
+                k, _, v = part.partition("=")
+                if not v.startswith('"') or not v.endswith('"'):
+                    raise ValueError(f"unquoted label value: {line!r}")
+                labels[k.strip()] = v[1:-1]
+        val = m.group("value")
+        value = float("nan") if val == "NaN" else float(val)
+        samples.append((m.group("name"), labels, value))
+    return {"types": types, "samples": samples}

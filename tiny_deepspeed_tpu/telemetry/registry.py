@@ -32,6 +32,7 @@ import numpy as np
 
 import jax
 
+from . import live
 from .health import HEALTH_FIELDS, health_dict
 from ..utils.profiling import StepTimer, comm_report, _quantile
 
@@ -165,10 +166,18 @@ class Telemetry:
     def counter(self, name: str) -> Counter:
         return self.counters.setdefault(name, Counter())
 
-    def gauge(self, name: str, value=None):
+    def gauge(self, name: str, value=None, **labels):
+        """Set/read a gauge.  Labels (e.g. ``replica=0``) qualify the
+        storage KEY — ``serve_queue_depth{replica=0}`` — so parallel
+        fleet replicas stop overwriting each other's values
+        (the PR-16 last-writer-wins wart).  Call sites keep the literal
+        base name; labels with None values are dropped, so single-engine
+        paths (``replica=None``) keep their historical bare keys."""
+        labels = {k: v for k, v in labels.items() if v is not None}
+        key = live.gauge_key(name, **labels) if labels else name
         if value is not None:
-            self.gauges[name] = float(value)
-        return self.gauges.get(name)
+            self.gauges[key] = float(value)
+        return self.gauges.get(key)
 
     def histogram(self, name: str) -> Histogram:
         return self.histograms.setdefault(name, Histogram())

@@ -134,7 +134,21 @@ _NUM = (int, float)
 #      rows rendered as the trace viewer's pipeline track) — all
 #      emitted only when a pipe program compiled, so older files stay
 #      byte-compatible with v13 readers
-SCHEMA_VERSION = 14
+#  15: + the live observability plane (telemetry/live.py / slo.py):
+#      request records carry `trace_id` (stamped at submit, surviving
+#      disagg prefill->decode migration, fleet failover adoption and
+#      journal recovery — the cross-engine correlation key) and, on
+#      migrated requests, comp_migrate_s (export->import wait billed to
+#      migration instead of queue; the components still partition
+#      lat_s); the new `slo` meta kind records per-tenant error-budget
+#      snapshots (windows / tenants / attainment / alerts, written by
+#      the engine when a burn-rate alert fires); gauges written by
+#      replica-tagged engines are keyed `name{replica=N}` (the registry
+#      labels them via live.gauge_key, replacing PR-16's last-writer-
+#      wins shared gauges) — all emitted only by live/SLO-configured or
+#      fleet runs, so plain serving files stay byte-compatible with
+#      v14 readers
+SCHEMA_VERSION = 15
 
 # step-record fields beyond the required step/ts; values are allowed types
 STEP_FIELDS: Dict[str, tuple] = {
@@ -186,6 +200,11 @@ META_KINDS = (
     # (serving/engine.py::tick; event-triggered + sampled emission so a
     # long-running server's metrics file stays bounded)
     "tick",
+    # serving tier: per-tenant SLO error-budget snapshot (telemetry/
+    # slo.py::SLOTracker.record) — multi-window burn rates, attainment
+    # and the alerts that fired; written by the engine when a burn-rate
+    # alert transitions to firing
+    "slo",
 )
 
 META_FIELDS: Dict[str, tuple] = {
@@ -311,6 +330,18 @@ META_FIELDS: Dict[str, tuple] = {
     "comp_decode_s": _NUM,
     "comp_preempt_s": _NUM,
     "comp_restart_s": _NUM,
+    # cross-engine migration wait (schema v15, disagg runs only): the
+    # export->import window of a prefill->decode handoff, split out of
+    # queue-wait so the disaggregation tax is attributable (the comp_*
+    # set still partitions lat_s; single-engine records omit it)
+    "comp_migrate_s": _NUM,
+    # cross-engine request correlation key (schema v15): stamped at
+    # submit(), rides the journal's submit line, KV migration handoffs
+    # and failover adoption — every record one request writes anywhere
+    # in a fleet carries the same trace_id, which is what lets
+    # serving_chrome_trace put one request's spans on correlated
+    # per-replica tracks
+    "trace_id": str,
     # speculative decoding (schema v7, spec-enabled engines only):
     # per-request draft yield — drafts proposed for this sequence and
     # drafts accepted into it (accept rate = accepted/proposed; the
@@ -375,6 +406,15 @@ META_FIELDS: Dict[str, tuple] = {
     # run_meta (serving runs): the ServeConfig geometry the trace viewer
     # needs to lay out slot tracks without rebuilding the engine
     "serve": dict,
+    # slo record (schema v15, telemetry/slo.py::SLOTracker.record):
+    # the burn-rate window lengths ({"s": [30.0, 300.0]}), the
+    # per-tenant budget table (objective / requests / good / attainment
+    # / budget_spent_frac / burn per window), the all-tenant attainment
+    # fraction, and the alert dicts that have fired so far
+    "windows": dict,
+    "tenants": dict,
+    "attainment": _NUM,
+    "alerts": list,
 }
 
 
@@ -470,6 +510,15 @@ def version_warning(metas) -> Optional[str]:
 # repo-hygiene name-drift guard (tests/test_repo_hygiene.py) greps the
 # call sites and fails on an undocumented gauge, so a renamed or new
 # gauge cannot silently desynchronize dashboards from the code.
+#
+# Labeling convention (schema v15): a call site passes the BARE name
+# documented here plus keyword labels — `gauge("serve_queue_depth",
+# v, replica=rid)` — and the registry keys the stored value
+# `serve_queue_depth{replica=0}` via telemetry/live.gauge_key.  Labels
+# whose value is None are dropped, so single-engine paths keep the
+# bare historical keys; readers recover (base, labels) with
+# live.parse_gauge_key.  The names below are the BASE names; labeled
+# variants are not separately registered.
 GAUGES: Dict[str, str] = {
     "anomaly_step_s": "wall time of the step that tripped the anomaly "
                       "detector",

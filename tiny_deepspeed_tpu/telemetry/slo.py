@@ -1,0 +1,283 @@
+# Copyright 2026 tiny-deepspeed-tpu authors
+# SPDX-License-Identifier: Apache-2.0
+
+"""SLO error budgets: per-tenant objectives, multi-window burn-rate
+accounting, and the alert rules that arm the flight recorder.
+
+An objective says what fraction of requests must be GOOD (finish ok,
+within optional TTFT / end-to-end latency targets); the error budget is
+the complement.  Burn rate is the SRE-standard ratio
+
+    burn = (bad fraction inside a window) / (1 - target)
+
+so burn 1.0 spends the budget exactly at the sustainable pace, and the
+classic multiwindow rules fire FAST (short window, high burn — page
+now, the budget dies in hours) and SLOW (long window, low burn — the
+trend is wrong).  A fast-burn alert flushes the engine's flight
+recorder via the ``on_alert`` hook, so the postmortem ring lands in the
+sidecar at the moment the budget started dying, not after the run.
+
+Everything is host-side python (stdlib only, no jax/numpy): requests
+are observed at their terminal exit with floats the engine already
+computed, and the tracker's snapshot is what ``/slo`` serves and what
+the ``slo`` record kind (schema v15) persists.
+
+The tracker also keeps a per-replica bad-fraction so ``FleetRouter``
+can CONSULT burn state when scoring dispatch — strictly advisory: it
+nudges scores, never vetoes a replica, and routing stays correct with
+no tracker attached.
+"""
+
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+__all__ = ["SLOObjective", "SLOTracker", "DEFAULT_WINDOWS_S"]
+
+DEFAULT_WINDOWS_S = (30.0, 300.0)   # (fast, slow) burn windows
+_DEFAULT = "_default"               # bucket for untagged traffic
+
+
+class SLOObjective:
+    """Per-tenant target: ``target`` fraction of requests must be good;
+    a request is good iff it finished ok AND met every set latency
+    bound (unset bounds don't constrain)."""
+
+    __slots__ = ("target", "ttft_s", "latency_s")
+
+    def __init__(self, *, target: float = 0.99,
+                 ttft_s: Optional[float] = None,
+                 latency_s: Optional[float] = None):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"SLO target must be in (0,1), got {target}")
+        self.target = float(target)
+        self.ttft_s = None if ttft_s is None else float(ttft_s)
+        self.latency_s = None if latency_s is None else float(latency_s)
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+    def good(self, *, ok: bool, ttft_s: Optional[float],
+             latency_s: Optional[float]) -> bool:
+        if not ok:
+            return False
+        if self.ttft_s is not None and (ttft_s is None
+                                        or ttft_s > self.ttft_s):
+            return False
+        if self.latency_s is not None and (latency_s is None
+                                           or latency_s > self.latency_s):
+            return False
+        return True
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"target": self.target, "ttft_s": self.ttft_s,
+                "latency_s": self.latency_s}
+
+    @classmethod
+    def parse(cls, spec: str) -> "SLOObjective":
+        """``"target=0.95,ttft=0.5,latency=5"`` -> objective (the
+        serve_bench --slo grammar; keys optional, any order)."""
+        kw: Dict[str, float] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k not in ("target", "ttft", "latency"):
+                raise ValueError(f"unknown SLO key {k!r} in {spec!r}")
+            kw[k] = float(v)
+        return cls(target=kw.get("target", 0.99),
+                   ttft_s=kw.get("ttft"), latency_s=kw.get("latency"))
+
+
+class SLOTracker:
+    """Multi-window burn-rate accounting over terminal request events.
+
+    ``observe()`` is called once per request at its terminal exit (the
+    engine's ``_terminal``), ``check()`` evaluates the alert rules and
+    fires ``on_alert`` on each transition into burning, ``snapshot()``
+    is the ``/slo`` payload, and ``record()`` persists an ``slo`` meta
+    record.  ``advise()`` is the router's advisory read.
+    """
+
+    def __init__(self, objectives: Optional[Dict[str, SLOObjective]] = None,
+                 *, default: Optional[SLOObjective] = None,
+                 windows_s: Tuple[float, float] = DEFAULT_WINDOWS_S,
+                 fast_burn: float = 14.0, slow_burn: float = 2.0,
+                 on_alert: Optional[Callable[[Dict[str, Any]], None]] = None):
+        self.objectives: Dict[str, SLOObjective] = dict(objectives or {})
+        self.default = default or SLOObjective()
+        self.windows_s = (float(windows_s[0]), float(windows_s[1]))
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.on_alert = on_alert
+        # per-tenant event ring: (t, good); bounded — the long window
+        # at production rates is what sizes it
+        self._events: Dict[str, Deque[Tuple[float, bool]]] = {}
+        self._good: Dict[str, int] = {}
+        self._total: Dict[str, int] = {}
+        # per-replica (t, good) ring feeding advise()
+        self._by_replica: Dict[str, Deque[Tuple[float, bool]]] = {}
+        self._burning: Dict[Tuple[str, str], bool] = {}  # (tenant, kind)
+        self.alerts: List[Dict[str, Any]] = []
+
+    def objective_for(self, tenant: Optional[str]) -> SLOObjective:
+        if tenant is not None and tenant in self.objectives:
+            return self.objectives[tenant]
+        return self.default
+
+    # ---- ingest ------------------------------------------------------
+
+    def observe(self, *, tenant: Optional[str], ok: bool,
+                ttft_s: Optional[float] = None,
+                latency_s: Optional[float] = None,
+                replica: Optional[int] = None,
+                t: Optional[float] = None) -> bool:
+        now = time.monotonic() if t is None else float(t)
+        name = tenant if tenant is not None else _DEFAULT
+        obj = self.objective_for(tenant)
+        good = obj.good(ok=ok, ttft_s=ttft_s, latency_s=latency_s)
+        ring = self._events.get(name)
+        if ring is None:
+            ring = self._events[name] = deque(maxlen=4096)
+        ring.append((now, good))
+        self._total[name] = self._total.get(name, 0) + 1
+        if good:
+            self._good[name] = self._good.get(name, 0) + 1
+        rid = "-" if replica is None else str(replica)
+        rring = self._by_replica.get(rid)
+        if rring is None:
+            rring = self._by_replica[rid] = deque(maxlen=4096)
+        rring.append((now, good))
+        return good
+
+    # ---- accounting --------------------------------------------------
+
+    @staticmethod
+    def _bad_frac(ring: Deque[Tuple[float, bool]], lo: float) -> Tuple[float, int]:
+        bad = n = 0
+        for t, good in ring:
+            if t < lo:
+                continue
+            n += 1
+            if not good:
+                bad += 1
+        return (bad / n if n else 0.0), n
+
+    def burn(self, tenant: Optional[str], window_s: float,
+             t: Optional[float] = None) -> float:
+        """Bad fraction inside the window over the error budget; 0.0
+        with no traffic (an idle tenant burns nothing)."""
+        now = time.monotonic() if t is None else float(t)
+        name = tenant if tenant is not None else _DEFAULT
+        ring = self._events.get(name)
+        if not ring:
+            return 0.0
+        frac, n = self._bad_frac(ring, now - window_s)
+        if not n:
+            return 0.0
+        return frac / self.objective_for(tenant).budget
+
+    def attainment(self, tenant: Optional[str] = None) -> float:
+        """All-time good fraction — the perf_diff sentinel value.
+        tenant=None aggregates every bucket."""
+        if tenant is not None:
+            tot = self._total.get(tenant, 0)
+            return self._good.get(tenant, 0) / tot if tot else 1.0
+        tot = sum(self._total.values())
+        return sum(self._good.values()) / tot if tot else 1.0
+
+    # ---- alert rules -------------------------------------------------
+
+    def check(self, t: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Evaluate fast/slow burn per tenant; fire ``on_alert`` on
+        each transition into burning and return the NEW alerts.  Cheap
+        enough to call every tick (rings are bounded)."""
+        now = time.monotonic() if t is None else float(t)
+        fired: List[Dict[str, Any]] = []
+        fast_w, slow_w = self.windows_s
+        for name in list(self._events):
+            tenant = None if name == _DEFAULT else name
+            for kind, window, thresh in (
+                    ("fast_burn", fast_w, self.fast_burn),
+                    ("slow_burn", slow_w, self.slow_burn)):
+                burn = self.burn(tenant, window, t=now)
+                key = (name, kind)
+                if burn >= thresh and not self._burning.get(key):
+                    self._burning[key] = True
+                    alert = {"tenant": name, "kind": kind,
+                             "burn": round(burn, 3),
+                             "window_s": window, "threshold": thresh,
+                             "t": round(now, 3)}
+                    self.alerts.append(alert)
+                    fired.append(alert)
+                    if self.on_alert is not None:
+                        self.on_alert(alert)
+                elif burn < thresh:
+                    self._burning[key] = False
+        return fired
+
+    # ---- advisory router hook ----------------------------------------
+
+    def advise(self, replica_id: Optional[int],
+               window_s: Optional[float] = None,
+               t: Optional[float] = None) -> float:
+        """Recent bad fraction on a replica, in [0, 1] — an ADVISORY
+        score penalty for dispatch (FleetRouter adds a small multiple
+        of this; a replica with no recent traffic advises 0.0)."""
+        now = time.monotonic() if t is None else float(t)
+        rid = "-" if replica_id is None else str(replica_id)
+        ring = self._by_replica.get(rid)
+        if not ring:
+            return 0.0
+        frac, n = self._bad_frac(
+            ring, now - (window_s or self.windows_s[0]))
+        return frac if n else 0.0
+
+    # ---- export ------------------------------------------------------
+
+    def snapshot(self, t: Optional[float] = None) -> Dict[str, Any]:
+        now = time.monotonic() if t is None else float(t)
+        fast_w, slow_w = self.windows_s
+        tenants: Dict[str, Any] = {}
+        for name in sorted(self._events):
+            tenant = None if name == _DEFAULT else name
+            obj = self.objective_for(tenant)
+            tenants[name] = {
+                "objective": obj.as_dict(),
+                "requests": self._total.get(name, 0),
+                "good": self._good.get(name, 0),
+                "attainment": round(self.attainment(name), 4),
+                "budget_spent_frac": round(
+                    min(1.0, (1.0 - self.attainment(name)) / obj.budget),
+                    4),
+                "burn": {
+                    f"{fast_w:g}s": round(
+                        self.burn(tenant, fast_w, t=now), 3),
+                    f"{slow_w:g}s": round(
+                        self.burn(tenant, slow_w, t=now), 3),
+                },
+            }
+        return {"windows_s": list(self.windows_s),
+                "thresholds": {"fast_burn": self.fast_burn,
+                               "slow_burn": self.slow_burn},
+                "tenants": tenants,
+                "attainment": round(self.attainment(), 4),
+                "alerts": list(self.alerts)}
+
+    def record(self, logger: Any, *, step: Optional[int] = None) -> None:
+        """Persist the budget state as an ``slo`` meta record (schema
+        v15).  Emitted only when a tracker is attached, so pre-v15
+        readers never see the kind."""
+        if logger is None:
+            return
+        snap = self.snapshot()
+        rec = {"kind": "slo", "windows": {"s": snap["windows_s"]},
+               "tenants": snap["tenants"],
+               "attainment": snap["attainment"],
+               "alerts": snap["alerts"]}
+        if step is not None:
+            rec["at_step"] = int(step)
+        logger.log_meta(**rec)

@@ -450,14 +450,63 @@ def chrome_trace(metas: List[dict], steps: List[dict],
 
 # -- serving timeline ---------------------------------------------------------
 
-# serving Chrome-trace track (tid) layout, pid 1 (pid 0 is training)
+# serving Chrome-trace track (tid) layout, pid 1 (pid 0 is training).
+# Fleet files (records carrying replica_id, schema v8+) get one PROCESS
+# per replica — pid _PID_REPLICA0 + replica — each with this same tid
+# layout inside, so one request's spans land on correlated per-replica
+# track groups under its trace_id (schema v15).
+_PID_SERVE = 1       # single-engine serving / records with no replica
+_PID_REPLICA0 = 2    # replica r -> pid _PID_REPLICA0 + r
 _TID_TICK = 0        # scheduler ticks
 _TID_TICK_SEG = 1    # per-tick wall split (sched/prefill/decode/fetch)
 _TID_QUEUE = 2       # request wait windows
 _TID_SLOT0 = 3       # decode slot s -> tid _TID_SLOT0 + s
 
 _WAIT_LABELS = {"queue": "queue wait", "preempt": "preempted wait",
-                "restart": "restart wait"}
+                "restart": "restart wait",
+                # disagg prefill->decode handoff (schema v15): the
+                # export->import window, billed to comp_migrate_s
+                "migrate": "migration wait"}
+
+# Cross-engine lifecycle markers (schema v15) and their attribution —
+# ONE rule, stated here and restated by Request.event's docstring: a
+# marker that LEAVES an engine (`exported`, `engine_lost`) attributes
+# every event since the previous marker to its replica; a marker that
+# ARRIVES (`imported`, `recovered`) attributes the events after it;
+# whatever trails the last marker belongs to the record's own
+# `replica_id` (the engine that wrote the terminal).
+_LEAVE_MARKERS = ("exported", "engine_lost")
+_ARRIVE_MARKERS = ("imported", "recovered")
+
+
+def _event_replicas(events: List[list], record_replica) -> List[object]:
+    """Per-event replica attribution for one request's lifecycle events
+    under the marker rule above (None throughout for pre-fleet records
+    that carry no replica stamps).  Events serialize as [name, t],
+    [name, t, slot] or [name, t, slot, replica] — slot may be null when
+    only the replica is stamped (a queued request's engine_lost)."""
+    n = len(events)
+    reps: List[object] = [None] * n
+    pending: List[int] = []
+    cur = None
+    for i, e in enumerate(events):
+        name = e[0]
+        rep = e[3] if len(e) > 3 and e[3] is not None else None
+        if name in _LEAVE_MARKERS and rep is not None:
+            reps[i] = rep
+            for j in pending:
+                reps[j] = rep
+            pending = []
+            cur = None
+        elif name in _ARRIVE_MARKERS and rep is not None:
+            reps[i] = cur = rep
+        elif cur is not None:
+            reps[i] = cur
+        else:
+            pending.append(i)
+    for j in pending:
+        reps[j] = record_replica
+    return reps
 _TICK_SEG_ORDER = ("sched_s", "draft_s", "prefill_s", "decode_s",
                    "fetch_s")
 _TICK_SEG_NAMES = {"sched_s": "host scheduling", "prefill_s": "prefill",
@@ -480,56 +529,91 @@ def has_serving_records(metas: List[dict]) -> bool:
 
 def _request_windows(rec: dict) -> List[dict]:
     """Fold one request record's lifecycle `events` into closed windows:
-    {"track": "queue" | ("slot", i), "label", "t0", "t1", "why"}.  Every
-    wait window closes at the admission (or terminal) that ends it; every
-    active window closes at the preemption / quarantine / expiry /
-    terminal that vacates the slot — the same timestamps the engine's
+    {"track": "queue" | ("slot", i), "label", "t0", "t1", "why",
+     "replica", "trace"}.  Every wait window closes at the admission
+    (or terminal) that ends it; every active window closes at the
+    preemption / migration / quarantine / expiry / terminal that
+    vacates the slot — the same timestamps the engine's
     latency-component partition uses, so track walls and `comp_*_s`
-    agree by construction."""
+    agree by construction.  A window's `replica` is the attribution of
+    the event that OPENED it (`_event_replicas`; None on single-engine
+    records), which routes it onto the right per-replica track group in
+    a fleet file; `trace` is the record's trace_id, the key that
+    correlates one request's windows ACROSS those groups."""
     rid = rec.get("request_id", "?")
+    trace = rec.get("trace_id")
     out: List[dict] = []
-    wait_t = wait_kind = None
-    active = None  # (slot, t_admitted)
+    events = rec.get("events") or []
+    reps = _event_replicas(events, rec.get("replica_id"))
+    wait_t = wait_kind = wait_rep = None
+    active = None  # (slot, t_admitted, replica)
 
     def close_wait(t):
         nonlocal wait_t
         if wait_t is not None and t > wait_t:
             out.append({"track": "queue",
                         "label": f"req {rid}", "t0": wait_t, "t1": t,
-                        "why": _WAIT_LABELS.get(wait_kind, wait_kind)})
+                        "why": _WAIT_LABELS.get(wait_kind, wait_kind),
+                        "replica": wait_rep, "trace": trace})
         wait_t = None
 
     def close_active(t, why):
         nonlocal active
         if active is not None:
-            slot, t_adm = active
+            slot, t_adm, rep = active
             out.append({"track": ("slot", slot),
                         "label": f"req {rid}", "t0": t_adm, "t1": t,
-                        "why": why})
+                        "why": why, "replica": rep, "trace": trace})
         active = None
 
-    for e in rec.get("events") or []:
+    for i, e in enumerate(events):
         name, t = e[0], float(e[1])
         slot = int(e[2]) if len(e) > 2 and e[2] is not None else None
+        rep = reps[i]
         if name in ("submitted", "recovered"):
             wait_t = t
             wait_kind = "queue" if name == "submitted" else "restart"
+            wait_rep = rep
         elif name == "admitted":
             close_wait(t)
-            active = (slot if slot is not None else 0, t)
+            active = (slot if slot is not None else 0, t, rep)
         elif name in ("preempted", "restart_requeued"):
             close_active(t, "preempted" if name == "preempted"
                          else "warm restart")
             wait_t = t
             wait_kind = ("preempt" if name == "preempted" else "restart")
+            wait_rep = rep
         elif name in ("quarantined", "expired"):
             close_active(t, name)
+        elif name == "exported":
+            # disagg handoff out of this engine: the active window
+            # closes at the export and the migration wait opens —
+            # billed to comp_migrate_s, drawn on the SOURCE replica's
+            # queue track (the export stamp is the source's)
+            close_active(t, "exported")
+            wait_t = t
+            wait_kind = "migrate"
+            wait_rep = rep
+        elif name == "imported":
+            # ...and closes when the destination engine seats the slot;
+            # the decode-side active window opens HERE, on the
+            # destination replica's slot track
+            close_wait(t)
+            active = (slot if slot is not None else 0, t, rep)
+        elif name == "engine_lost":
+            # the replica died with this request queued or active: both
+            # window kinds close at the death stamp (on the DEAD
+            # replica's tracks); the sibling's `recovered` re-opens the
+            # wait on its own
+            close_active(t, "engine lost")
+            close_wait(t)
         elif name == "admission_aborted":
             # a real prefill failure bounced the admission: the aborted
             # sliver closes here and the request re-queues (the engine
             # re-opened its wait window at the admission stamp)
             close_active(t, "aborted")
             wait_t = t
+            wait_rep = rep
         elif name.startswith("terminal:"):
             close_active(t, name.split(":", 1)[1])
             close_wait(t)
@@ -542,7 +626,31 @@ def serving_chrome_trace(metas: List[dict],
     spans + their measured wall split, one queue track, one track per
     decode slot, quarantine/restart instant markers.  Timestamps are
     microseconds from the earliest serving stamp (every serving record
-    shares the engine's monotonic clock)."""
+    shares one in-process monotonic clock, so tracks align exactly —
+    across replicas too).
+
+    Fleet files (records carrying replica_id) lay out one PROCESS per
+    replica, each with the full tick/queue/slot tid set; a request that
+    crossed engines (disagg migration, failover) gets its windows on
+    EVERY replica it touched, correlated by the `trace_id` in their
+    span args — the Perfetto view the cross-engine tail postmortem
+    reads.
+
+    Shared-stream disambiguation is ONE rule, applied to every
+    coordinate collision in a multi-lifetime / multi-replica file:
+      * a record that carries an explicit track key routes by it —
+        replica_id on tick records picks the replica's process, and
+        lifecycle windows carry the (trace_id, replica) attribution of
+        the event that opened them (`_event_replicas`);
+      * a record WITHOUT one anchors by FILE ORDER: the last matching
+        record written before it, else the first after.  Flight flushes
+        are the canonical without-case — one sidecar can carry two
+        engine lifetimes (pre-kill, then recovered) whose tick counters
+        both restart at 0, and the engine emits the tick record ahead
+        of its flush (while recover() flushes before the fresh engine's
+        tick 0 exists), which is exactly what before-else-after
+        encodes.  A flight that DOES carry replica_id restricts its
+        candidate ticks to that replica first."""
     ticks = [m for m in metas if m.get("kind") == "tick"
              and isinstance(m.get("t_s"), (int, float))]
     reqs = [m for m in metas if m.get("kind") == "request"]
@@ -555,6 +663,17 @@ def serving_chrome_trace(metas: List[dict],
             (w["track"][1] for w in windows
              if isinstance(w["track"], tuple)), default=-1)
 
+    replicas = sorted({
+        r for r in ([t.get("replica_id") for t in ticks]
+                    + [w.get("replica") for w in windows])
+        if isinstance(r, int) and not isinstance(r, bool)})
+
+    def pid_of(rep) -> int:
+        if not replicas or not isinstance(rep, int) \
+                or isinstance(rep, bool):
+            return _PID_SERVE
+        return _PID_REPLICA0 + rep
+
     stamps = ([t["t_s"] for t in ticks]
               + [w["t0"] for w in windows])
     t0 = min(stamps, default=0.0)
@@ -562,26 +681,36 @@ def serving_chrome_trace(metas: List[dict],
     def us(seconds: float) -> float:
         return round(seconds * 1e6, 3)
 
-    events: List[dict] = [
-        {"ph": "M", "pid": 1, "name": "process_name",
-         "args": {"name": f"serving run {source}".strip()}},
-        {"ph": "M", "pid": 1, "tid": _TID_TICK, "name": "thread_name",
-         "args": {"name": "scheduler ticks"}},
-        {"ph": "M", "pid": 1, "tid": _TID_TICK_SEG, "name": "thread_name",
-         "args": {"name": "tick wall split"}},
-        {"ph": "M", "pid": 1, "tid": _TID_QUEUE, "name": "thread_name",
-         "args": {"name": "queue"}},
-    ]
-    for s in range(n_slots):
-        events.append({"ph": "M", "pid": 1, "tid": _TID_SLOT0 + s,
+    events: List[dict] = []
+    used_pids = sorted({pid_of(t.get("replica_id")) for t in ticks}
+                       | {pid_of(w.get("replica")) for w in windows}
+                       ) or [_PID_SERVE]
+    for pid in used_pids:
+        pname = (f"serving run {source}".strip() if pid == _PID_SERVE
+                 else f"serving replica {pid - _PID_REPLICA0} "
+                      f"{source}".strip())
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": pname}})
+        events.append({"ph": "M", "pid": pid, "tid": _TID_TICK,
                        "name": "thread_name",
-                       "args": {"name": f"slot {s}"}})
+                       "args": {"name": "scheduler ticks"}})
+        events.append({"ph": "M", "pid": pid, "tid": _TID_TICK_SEG,
+                       "name": "thread_name",
+                       "args": {"name": "tick wall split"}})
+        events.append({"ph": "M", "pid": pid, "tid": _TID_QUEUE,
+                       "name": "thread_name",
+                       "args": {"name": "queue"}})
+        for s in range(n_slots):
+            events.append({"ph": "M", "pid": pid, "tid": _TID_SLOT0 + s,
+                           "name": "thread_name",
+                           "args": {"name": f"slot {s}"}})
 
     for rec in ticks:
+        pid = pid_of(rec.get("replica_id"))
         start = rec["t_s"] - t0
         wall = float(rec.get("wall_s") or 0.0)
         events.append({
-            "ph": "X", "pid": 1, "tid": _TID_TICK,
+            "ph": "X", "pid": pid, "tid": _TID_TICK,
             "name": f"tick {rec.get('tick', '?')}",
             "ts": us(start), "dur": us(wall),
             "args": _json_safe({
@@ -601,7 +730,7 @@ def serving_chrome_trace(metas: List[dict],
             if not isinstance(seg, (int, float)) or seg <= 0.0:
                 continue
             events.append({
-                "ph": "X", "pid": 1, "tid": _TID_TICK_SEG,
+                "ph": "X", "pid": pid, "tid": _TID_TICK_SEG,
                 "name": _TICK_SEG_NAMES[key],
                 "ts": us(cursor), "dur": us(seg),
                 "args": {"seconds": seg, "schematic_position": True},
@@ -609,46 +738,52 @@ def serving_chrome_trace(metas: List[dict],
             cursor += seg
         if rec.get("restarted"):
             events.append({
-                "ph": "i", "pid": 1, "tid": _TID_TICK, "s": "p",
+                "ph": "i", "pid": pid, "tid": _TID_TICK, "s": "p",
                 "name": "watchdog warm restart", "ts": us(start + wall),
             })
 
     for w in windows:
+        pid = pid_of(w.get("replica"))
         tid = (_TID_QUEUE if w["track"] == "queue"
                else _TID_SLOT0 + w["track"][1])
+        args = {"window": w["why"]}
+        if w.get("trace") is not None:
+            args["trace_id"] = w["trace"]
+        if w.get("replica") is not None:
+            args["replica"] = w["replica"]
         events.append({
-            "ph": "X", "pid": 1, "tid": tid, "name": w["label"],
+            "ph": "X", "pid": pid, "tid": tid, "name": w["label"],
             "ts": us(w["t0"] - t0), "dur": us(w["t1"] - w["t0"]),
-            "args": {"window": w["why"]},
+            "args": args,
         })
         if w["why"] == "quarantined":
             events.append({
-                "ph": "i", "pid": 1, "tid": tid, "s": "t",
+                "ph": "i", "pid": pid, "tid": tid, "s": "t",
                 "name": f"quarantine ({w['label']})",
                 "ts": us(w["t1"] - t0),
             })
 
-    # flight markers anchor by FILE ORDER, not just tick index: one
-    # sidecar can carry two engine lifetimes (pre-kill engine, then the
-    # recovered one) whose tick counters both start at 0 — the right
-    # anchor is the last matching tick WRITTEN BEFORE the flush (the
-    # engine emits the tick record ahead of its flush), falling back to
-    # the first matching one after it (recover() flushes before the
-    # fresh engine's tick 0 exists)
+    # flight markers: the file-order half of the shared-stream rule
+    # (docstring above) — last matching tick written before the flush,
+    # else first after; same-replica ticks preferred when the flight
+    # carries a replica_id
     for fi, fl in enumerate(metas):
         if fl.get("kind") != "flight" or not str(
-                fl.get("reason", "")).startswith("serve_"):
+                fl.get("reason", "")).startswith(("serve_", "slo_")):
             continue
         at = fl.get("at_step")
+        frep = fl.get("replica_id")
         matches = [(mi, m) for mi, m in enumerate(metas)
                    if m.get("kind") == "tick" and m.get("tick") == at
-                   and isinstance(m.get("t_s"), (int, float))]
+                   and isinstance(m.get("t_s"), (int, float))
+                   and (frep is None or m.get("replica_id") == frep)]
         before = [m for mi, m in matches if mi < fi]
         after = [m for mi, m in matches if mi > fi]
         anchor = before[-1] if before else (after[0] if after else None)
         if anchor is not None:
             events.append({
-                "ph": "i", "pid": 1, "tid": _TID_TICK, "s": "p",
+                "ph": "i", "pid": pid_of(anchor.get("replica_id")),
+                "tid": _TID_TICK, "s": "p",
                 "name": f"flight flush ({fl['reason']})",
                 "ts": us(anchor["t_s"] - t0
                          + float(anchor.get("wall_s") or 0.0)),
@@ -663,5 +798,6 @@ def serving_chrome_trace(metas: List[dict],
             "slots": n_slots,
             "ticks": len(ticks),
             "requests": len(reqs),
+            "replicas": replicas,
         },
     }
